@@ -1,0 +1,24 @@
+"""mx_rcnn_tpu: a TPU-native two-stage detection framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the MXNet
+Faster R-CNN codebase (reference: xuelanglv/mx-rcnn, a fork of
+ijkguo/mx-rcnn).  Nothing here is a translation: the reference's
+host-side custom ops (``rcnn/symbol/proposal.py``,
+``rcnn/symbol/proposal_target.py``), Cython/CUDA kernels
+(``rcnn/cython/``), and MXNet Module/KVStore runtime are replaced by a
+single statically-shaped jitted train step, in-graph detection ops, and
+``jax.sharding`` data parallelism over a device mesh.
+
+Layers (bottom-up, see SURVEY.md section 8):
+  geometry/  pure-JAX box math            (replaces rcnn/processing, rcnn/cython/bbox.pyx)
+  ops/       static-shape detection ops   (replaces custom ops + gpu_nms + ROIPooling)
+  models/    Flax backbones/necks/heads   (replaces rcnn/symbol)
+  detection/ assembled train/test steps   (replaces symbol train/test graph variants)
+  train/     optimizer/metrics/checkpoint (replaces rcnn/core module/metric/callback)
+  parallel/  mesh + sharding              (replaces Module ctx slicing + KVStore)
+  data/      datasets + static batching   (replaces rcnn/io, rcnn/dataset, rcnn/core/loader)
+  evalutil/  VOC / COCO mAP evaluators    (replaces pascal_voc_eval + pycocotools eval)
+  cli/       drivers                      (replaces train_end2end.py, test.py, demo.py)
+"""
+
+__version__ = "0.1.0"

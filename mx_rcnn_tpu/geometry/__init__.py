@@ -1,0 +1,30 @@
+from mx_rcnn_tpu.geometry.boxes import (
+    area,
+    clip_boxes,
+    decode_boxes,
+    encode_boxes,
+    iou_matrix,
+    valid_box_mask,
+)
+from mx_rcnn_tpu.geometry.anchors import generate_base_anchors, shifted_anchors
+from mx_rcnn_tpu.geometry.losses import (
+    huber_loss,
+    masked_softmax_cross_entropy,
+    smooth_l1,
+    weighted_smooth_l1,
+)
+
+__all__ = [
+    "area",
+    "clip_boxes",
+    "decode_boxes",
+    "encode_boxes",
+    "iou_matrix",
+    "valid_box_mask",
+    "generate_base_anchors",
+    "shifted_anchors",
+    "huber_loss",
+    "masked_softmax_cross_entropy",
+    "smooth_l1",
+    "weighted_smooth_l1",
+]

@@ -1,0 +1,79 @@
+"""Anchor generation.
+
+Replaces ``rcnn/processing/generate_anchor.py::generate_anchors`` (the k base
+anchors) and the per-feature-map shift enumeration done inside the reference
+Proposal custom op (``rcnn/symbol/proposal.py``) and ``rcnn/io/rpn.py::
+assign_anchor``.  All shapes are static given (stride, H, W), so under jit
+the whole anchor grid constant-folds into the compiled executable — the
+O(H*W*k) host-side numpy enumeration the reference pays every iteration
+disappears entirely.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate_base_anchors(
+    base_size: int = 16,
+    ratios=(0.5, 1.0, 2.0),
+    scales=(8, 16, 32),
+    legacy_plus_one: bool = False,
+) -> np.ndarray:
+    """The k = len(ratios)*len(scales) base anchors, centered on a base cell.
+
+    Numerically matches the reference's ``generate_anchors`` (which produces
+    e.g. the canonical [-84, -40, 99, 55] style anchors for base 16) when
+    ``legacy_plus_one=True``; the modern convention centers at base_size/2.
+    Returned as numpy: this is config-time, not trace-time, work.
+    """
+    ratios = np.asarray(ratios, dtype=np.float64)
+    scales = np.asarray(scales, dtype=np.float64)
+    if legacy_plus_one:
+        w = h = float(base_size)
+        cx = cy = 0.5 * (base_size - 1)
+        size = w * h
+        size_ratios = size / ratios
+        ws = np.round(np.sqrt(size_ratios))
+        hs = np.round(ws * ratios)
+        ws = (ws[:, None] * scales[None, :]).reshape(-1)
+        hs = (hs[:, None] * scales[None, :]).reshape(-1)
+        return np.stack(
+            [
+                cx - 0.5 * (ws - 1),
+                cy - 0.5 * (hs - 1),
+                cx + 0.5 * (ws - 1),
+                cy + 0.5 * (hs - 1),
+            ],
+            axis=1,
+        ).astype(np.float32)
+    # Modern: exact sqrt areas, no rounding, centered at base/2.
+    cx = cy = 0.5 * base_size
+    size = float(base_size * base_size)
+    ws = np.sqrt(size / ratios)
+    hs = ws * ratios
+    ws = (ws[:, None] * scales[None, :]).reshape(-1)
+    hs = (hs[:, None] * scales[None, :]).reshape(-1)
+    return np.stack(
+        [cx - 0.5 * ws, cy - 0.5 * hs, cx + 0.5 * ws, cy + 0.5 * hs], axis=1
+    ).astype(np.float32)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def shifted_anchors(base_anchors: jnp.ndarray, stride: int, height: int, width: int):
+    """Tile base anchors over an H x W feature grid.
+
+    Returns (H*W*k, 4) anchors in input-image coordinates, ordered so that
+    the anchor axis unrolls as (row-major spatial, then k) — matching how a
+    (H, W, k*4) conv output reshapes to (H*W*k, 4).
+    """
+    shift_x = jnp.arange(width, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(height, dtype=jnp.float32) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+    out = shifts[:, :, None, :] + base_anchors[None, None, :, :]  # (H, W, k, 4)
+    return out.reshape(-1, 4)

@@ -1,0 +1,165 @@
+"""Pure-JAX box geometry.
+
+TPU-native replacement for the reference's host-side geometry stack:
+``rcnn/processing/bbox_transform.py`` (bbox_overlaps, nonlinear_transform,
+nonlinear_pred, clip_boxes) and the Cython hot kernel
+``rcnn/cython/bbox.pyx`` (O(N*K) IoU matrix).  Everything here is
+vectorized, jit-safe, static-shape, and differentiable where meaningful.
+
+Box convention: ``(x1, y1, x2, y2)`` corner format, matching the
+reference.  Like the reference, widths/heights are computed with a
+``+ 1`` offset OFF by default — the reference uses the legacy
+``x2 - x1 + 1.0`` convention everywhere; we expose it via ``legacy_plus_one``
+so parity tests can check both, but the framework default is the modern
+convention (used by FPN-era recipes that the BASELINE north star targets).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Matches the reference's bbox clamp on dw/dh before exp() so decoded boxes
+# cannot overflow float32 (np.log(1000.0 / 16.0) in modern detectors).
+BBOX_XFORM_CLIP = 4.135166556742356
+
+
+def _wh(boxes: jnp.ndarray, legacy_plus_one: bool = False):
+    off = 1.0 if legacy_plus_one else 0.0
+    w = boxes[..., 2] - boxes[..., 0] + off
+    h = boxes[..., 3] - boxes[..., 1] + off
+    return w, h
+
+
+def area(boxes: jnp.ndarray, legacy_plus_one: bool = False) -> jnp.ndarray:
+    """Box areas. boxes: (..., 4)."""
+    w, h = _wh(boxes, legacy_plus_one)
+    return jnp.maximum(w, 0.0) * jnp.maximum(h, 0.0)
+
+
+def iou_matrix(
+    boxes: jnp.ndarray,
+    query: jnp.ndarray,
+    legacy_plus_one: bool = False,
+) -> jnp.ndarray:
+    """Pairwise IoU between two box sets.
+
+    Replaces ``rcnn/cython/bbox.pyx::bbox_overlaps`` (and the pure-python
+    fallback in ``rcnn/processing/bbox_transform.py``): the O(N*K) loop
+    becomes one broadcasted computation that XLA tiles onto the VPU.
+
+    Args:
+      boxes: (N, 4).
+      query: (K, 4).
+    Returns:
+      (N, K) IoU matrix.  Degenerate (zero-area) boxes produce 0 rows/cols.
+    """
+    off = 1.0 if legacy_plus_one else 0.0
+    lt = jnp.maximum(boxes[:, None, :2], query[None, :, :2])  # (N, K, 2)
+    rb = jnp.minimum(boxes[:, None, 2:], query[None, :, 2:])  # (N, K, 2)
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = area(boxes, legacy_plus_one)[:, None]
+    a2 = area(query, legacy_plus_one)[None, :]
+    union = a1 + a2 - inter
+    return jnp.where(union > 0.0, inter / jnp.where(union > 0.0, union, 1.0), 0.0)
+
+
+def _center(boxes: jnp.ndarray, legacy_plus_one: bool = False):
+    """(w, h, cx, cy) of boxes under the chosen width convention."""
+    off = 1.0 if legacy_plus_one else 0.0
+    w, h = _wh(boxes, legacy_plus_one)
+    cx = boxes[..., 0] + 0.5 * (w - off)
+    cy = boxes[..., 1] + 0.5 * (h - off)
+    return w, h, cx, cy
+
+
+def encode_boxes(
+    boxes: jnp.ndarray,
+    anchors: jnp.ndarray,
+    weights: tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0),
+    legacy_plus_one: bool = False,
+) -> jnp.ndarray:
+    """Encode target ``boxes`` relative to ``anchors`` as (dx, dy, dw, dh).
+
+    Replaces ``rcnn/processing/bbox_transform.py::nonlinear_transform``.
+    ``weights`` play the role of the reference's ``BBOX_STDS`` division
+    (targets are multiplied by the weights; the reference divides by stds —
+    weights = 1/std).
+    """
+    aw, ah, ax, ay = _center(anchors, legacy_plus_one)
+    gw, gh, gx, gy = _center(boxes, legacy_plus_one)
+
+    aw = jnp.maximum(aw, 1e-6)
+    ah = jnp.maximum(ah, 1e-6)
+    wx, wy, ww, wh_ = weights
+    dx = wx * (gx - ax) / aw
+    dy = wy * (gy - ay) / ah
+    dw = ww * jnp.log(jnp.maximum(gw, 1e-6) / aw)
+    dh = wh_ * jnp.log(jnp.maximum(gh, 1e-6) / ah)
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def decode_boxes(
+    deltas: jnp.ndarray,
+    anchors: jnp.ndarray,
+    weights: tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0),
+    legacy_plus_one: bool = False,
+) -> jnp.ndarray:
+    """Apply regression ``deltas`` to ``anchors`` -> boxes.
+
+    Replaces ``rcnn/processing/bbox_transform.py::nonlinear_pred`` (used by
+    the Proposal custom op forward and by test-time ``im_detect``).
+    """
+    aw, ah, ax, ay = _center(anchors, legacy_plus_one)
+
+    wx, wy, ww, wh_ = weights
+    dx = deltas[..., 0] / wx
+    dy = deltas[..., 1] / wy
+    dw = jnp.clip(deltas[..., 2] / ww, max=BBOX_XFORM_CLIP)
+    dh = jnp.clip(deltas[..., 3] / wh_, max=BBOX_XFORM_CLIP)
+
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+
+    off = 1.0 if legacy_plus_one else 0.0
+    x1 = cx - 0.5 * (w - off)
+    y1 = cy - 0.5 * (h - off)
+    x2 = cx + 0.5 * (w - off)
+    y2 = cy + 0.5 * (h - off)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def clip_boxes(
+    boxes: jnp.ndarray, height, width, legacy_plus_one: bool = False
+) -> jnp.ndarray:
+    """Clip boxes to image bounds.
+
+    Replaces ``rcnn/processing/bbox_transform.py::clip_boxes``.  ``height``
+    and ``width`` may be traced scalars (per-image true sizes inside a padded
+    batch).
+    """
+    off = 1.0 if legacy_plus_one else 0.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, width - off)
+    y1 = jnp.clip(boxes[..., 1], 0.0, height - off)
+    x2 = jnp.clip(boxes[..., 2], 0.0, width - off)
+    y2 = jnp.clip(boxes[..., 3], 0.0, height - off)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def valid_box_mask(
+    boxes: jnp.ndarray, min_size: float = 0.0, legacy_plus_one: bool = False
+) -> jnp.ndarray:
+    """Mask of boxes at least min_size wide and tall.
+
+    Replaces the min-size filter inside the reference Proposal op
+    (``rcnn/symbol/proposal.py``: ``_filter_boxes``).  Returns a boolean mask
+    instead of compacting — static shapes; padded entries are masked, never
+    removed.  ``>=`` matches the reference's ``ws >= min_size``; at
+    ``min_size == 0`` degenerate zero-extent boxes are still rejected.
+    """
+    w, h = _wh(boxes, legacy_plus_one)
+    if min_size <= 0.0:
+        return (w > 0.0) & (h > 0.0)
+    return (w >= min_size) & (h >= min_size)

@@ -1,0 +1,81 @@
+"""Detection losses.
+
+TPU-native replacements for the loss operators the reference pulls from the
+MXNet engine (SURVEY.md section 3.5 "engine-side native ops"):
+
+- ``SoftmaxOutput(ignore_label=-1, use_ignore=True, normalization='valid')``
+  -> :func:`masked_softmax_cross_entropy` — an explicit masked CE with
+  valid-count normalization, instead of a fused op with baked-in gradient.
+- ``mx.symbol.smooth_l1(scalar=sigma)`` with in-graph inside/outside weight
+  tensors -> :func:`weighted_smooth_l1` / :func:`huber_loss`.
+
+All functions are shape-polymorphic over leading axes and jit/grad-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_softmax_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    valid_mask: jnp.ndarray,
+    normalize_by_valid: bool = True,
+) -> jnp.ndarray:
+    """Softmax CE over the last axis, ignoring entries where ``valid_mask`` is 0.
+
+    ``labels`` are int class ids; entries with ``valid_mask == 0`` contribute
+    zero loss and zero gradient (the reference marks them with label -1 and
+    ``use_ignore``).  Normalization is by the number of valid entries
+    (``normalization='valid'``), never by the padded total.
+    """
+    valid = valid_mask.astype(logits.dtype)
+    safe_labels = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    ce = ce * valid
+    if normalize_by_valid:
+        return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(ce)
+
+
+def huber_loss(pred: jnp.ndarray, target: jnp.ndarray, delta: float = 1.0) -> jnp.ndarray:
+    """Elementwise Huber.  ``delta = 1/sigma^2`` relative to the reference's
+    ``smooth_l1(scalar=sigma)`` parameterization: smooth_l1 with sigma
+    transitions at |x| = 1/sigma^2; huber with delta transitions at |x| =
+    delta, with the quadratic zone scaled to match slope continuity."""
+    diff = jnp.abs(pred - target)
+    quad = 0.5 * diff * diff / delta
+    lin = diff - 0.5 * delta
+    return jnp.where(diff < delta, quad, lin)
+
+
+def smooth_l1(x: jnp.ndarray, sigma: float = 1.0) -> jnp.ndarray:
+    """The reference's exact smooth_l1 parameterization (sigma form):
+    0.5*(sigma*x)^2 if |x| < 1/sigma^2 else |x| - 0.5/sigma^2."""
+    s2 = sigma * sigma
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+def weighted_smooth_l1(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    inside_weight: jnp.ndarray,
+    outside_weight: jnp.ndarray | None = None,
+    sigma: float = 1.0,
+    normalizer: jnp.ndarray | float = 1.0,
+) -> jnp.ndarray:
+    """Reference-style bbox regression loss.
+
+    Mirrors the train-graph pattern in ``rcnn/symbol/symbol_vgg.py``:
+    ``smooth_l1((pred - target) * inside_w) * outside_w``, summed and divided
+    by a normalizer (RPN: batch anchors; RCNN: sampled rois).
+    """
+    diff = (pred - target) * inside_weight
+    loss = smooth_l1(diff, sigma=sigma)
+    if outside_weight is not None:
+        loss = loss * outside_weight
+    return jnp.sum(loss) / jnp.maximum(normalizer, 1.0)

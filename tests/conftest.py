@@ -1,0 +1,39 @@
+"""Test config: force JAX onto CPU with 8 fake devices.
+
+This is the standard JAX analog of a fake-NCCL backend (SURVEY.md section 5):
+multi-chip sharding logic is exercised on an 8-device CPU mesh with no TPU
+attached.  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# This image's sitecustomize registers a TPU-tunnel PJRT plugin in every
+# interpreter; if the tunnel is degraded, *any* backend init (even cpu)
+# blocks on its retries.  Tests must be hermetic on CPU, so drop the
+# plugin's backend factory before the first backend initialization.
+import jax  # noqa: E402  (safe: importing jax does not init backends)
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _name in list(getattr(_xb, "_backend_factories", {})):
+    if _name not in ("cpu", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+
+# sitecustomize may have imported jax before this file ran, in which case
+# jax.config captured JAX_PLATFORMS from the outer environment — override
+# through the config API, not the env var.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

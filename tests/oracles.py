@@ -1,0 +1,114 @@
+"""Numpy oracle implementations used to validate the JAX/Pallas ops.
+
+These follow the reference semantics (rcnn/processing/*, rcnn/cython/*) in
+plain readable numpy — the same role the pure-python NMS in
+``rcnn/processing/nms.py`` played as an implicit oracle, but actually wired
+into an automated suite.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def iou_matrix_np(boxes: np.ndarray, query: np.ndarray, plus_one: bool = False):
+    off = 1.0 if plus_one else 0.0
+    n, k = len(boxes), len(query)
+    out = np.zeros((n, k), dtype=np.float64)
+    for i in range(n):
+        for j in range(k):
+            ix1 = max(boxes[i, 0], query[j, 0])
+            iy1 = max(boxes[i, 1], query[j, 1])
+            ix2 = min(boxes[i, 2], query[j, 2])
+            iy2 = min(boxes[i, 3], query[j, 3])
+            iw = max(ix2 - ix1 + off, 0.0)
+            ih = max(iy2 - iy1 + off, 0.0)
+            inter = iw * ih
+            a1 = max(boxes[i, 2] - boxes[i, 0] + off, 0) * max(
+                boxes[i, 3] - boxes[i, 1] + off, 0
+            )
+            a2 = max(query[j, 2] - query[j, 0] + off, 0) * max(
+                query[j, 3] - query[j, 1] + off, 0
+            )
+            union = a1 + a2 - inter
+            out[i, j] = inter / union if union > 0 else 0.0
+    return out
+
+
+def greedy_nms_np(boxes: np.ndarray, scores: np.ndarray, iou_thresh: float):
+    """Classic greedy NMS (rcnn/processing/nms.py::py_nms semantics, modern
+    +0 box convention). Returns kept indices in descending-score order."""
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    suppressed = np.zeros(len(boxes), dtype=bool)
+    for idx in order:
+        if suppressed[idx]:
+            continue
+        keep.append(idx)
+        for jdx in order:
+            if suppressed[jdx] or jdx == idx:
+                continue
+            iou = iou_matrix_np(boxes[idx : idx + 1], boxes[jdx : jdx + 1])[0, 0]
+            if iou > iou_thresh:
+                suppressed[jdx] = True
+    return np.array(keep, dtype=np.int64)
+
+
+def encode_np(boxes: np.ndarray, anchors: np.ndarray):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    gw = boxes[:, 2] - boxes[:, 0]
+    gh = boxes[:, 3] - boxes[:, 1]
+    gx = boxes[:, 0] + 0.5 * gw
+    gy = boxes[:, 1] + 0.5 * gh
+    return np.stack(
+        [(gx - ax) / aw, (gy - ay) / ah, np.log(gw / aw), np.log(gh / ah)], axis=1
+    )
+
+
+def roi_align_np(
+    features: np.ndarray,
+    rois: np.ndarray,
+    output_size: int,
+    spatial_scale: float,
+    sampling_ratio: int = 2,
+):
+    """Reference ROIAlign (Mask R-CNN paper semantics, aligned=False):
+    features (H, W, C), rois (N, 4) in image coords. Output (N, S, S, C)."""
+    h, w, c = features.shape
+    n = len(rois)
+    out = np.zeros((n, output_size, output_size, c), dtype=np.float64)
+
+    def bilinear(y, x):
+        if y < -1.0 or y > h or x < -1.0 or x > w:
+            return np.zeros(c)
+        y = min(max(y, 0.0), h - 1)
+        x = min(max(x, 0.0), w - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+        ly, lx = y - y0, x - x0
+        return (
+            features[y0, x0] * (1 - ly) * (1 - lx)
+            + features[y0, x1] * (1 - ly) * lx
+            + features[y1, x0] * ly * (1 - lx)
+            + features[y1, x1] * ly * lx
+        )
+
+    for i in range(n):
+        x1, y1, x2, y2 = rois[i] * spatial_scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bin_w = rw / output_size
+        bin_h = rh / output_size
+        for py in range(output_size):
+            for px in range(output_size):
+                acc = np.zeros(c)
+                for iy in range(sampling_ratio):
+                    for ix in range(sampling_ratio):
+                        sy = y1 + (py + (iy + 0.5) / sampling_ratio) * bin_h
+                        sx = x1 + (px + (ix + 0.5) / sampling_ratio) * bin_w
+                        acc += bilinear(sy, sx)
+                out[i, py, px] = acc / (sampling_ratio * sampling_ratio)
+    return out

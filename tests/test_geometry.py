@@ -1,0 +1,193 @@
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.geometry import (
+    area,
+    clip_boxes,
+    decode_boxes,
+    encode_boxes,
+    generate_base_anchors,
+    iou_matrix,
+    shifted_anchors,
+    valid_box_mask,
+)
+from mx_rcnn_tpu.geometry.losses import (
+    huber_loss,
+    masked_softmax_cross_entropy,
+    smooth_l1,
+    weighted_smooth_l1,
+)
+
+from oracles import encode_np, iou_matrix_np
+
+
+def random_boxes(rng, n, size=100.0):
+    xy = rng.uniform(0, size, (n, 2))
+    wh = rng.uniform(1, size / 2, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_iou_against_oracle(rng):
+    a = random_boxes(rng, 37)
+    b = random_boxes(rng, 11)
+    got = np.asarray(iou_matrix(jnp.asarray(a), jnp.asarray(b)))
+    want = iou_matrix_np(a, b)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_iou_legacy_plus_one(rng):
+    a = random_boxes(rng, 9)
+    b = random_boxes(rng, 5)
+    got = np.asarray(iou_matrix(jnp.asarray(a), jnp.asarray(b), legacy_plus_one=True))
+    want = iou_matrix_np(a, b, plus_one=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_iou_identity_and_disjoint():
+    boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], dtype=jnp.float32)
+    m = np.asarray(iou_matrix(boxes, boxes))
+    np.testing.assert_allclose(np.diag(m), [1.0, 1.0], atol=1e-6)
+    assert m[0, 1] == 0.0
+
+
+def test_iou_degenerate_box_is_zero():
+    a = jnp.asarray([[5.0, 5.0, 5.0, 5.0]])
+    b = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    assert float(iou_matrix(a, b)[0, 0]) == 0.0
+
+
+def test_encode_against_oracle(rng):
+    boxes = random_boxes(rng, 23)
+    anchors = random_boxes(rng, 23)
+    got = np.asarray(encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors)))
+    want = encode_np(boxes, anchors)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_encode_decode_roundtrip(rng):
+    boxes = random_boxes(rng, 50)
+    anchors = random_boxes(rng, 50)
+    deltas = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors))
+    back = decode_boxes(deltas, jnp.asarray(anchors))
+    np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-3, atol=1e-2)
+
+
+def test_encode_decode_roundtrip_with_weights(rng):
+    w = (10.0, 10.0, 5.0, 5.0)
+    boxes = random_boxes(rng, 16)
+    anchors = random_boxes(rng, 16)
+    deltas = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors), weights=w)
+    back = decode_boxes(deltas, jnp.asarray(anchors), weights=w)
+    np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-3, atol=1e-2)
+
+
+def test_decode_zero_delta_is_identity(rng):
+    anchors = random_boxes(rng, 8)
+    out = decode_boxes(jnp.zeros((8, 4)), jnp.asarray(anchors))
+    np.testing.assert_allclose(np.asarray(out), anchors, rtol=1e-5, atol=1e-4)
+
+
+def test_decode_clamps_extreme_dwdh(rng):
+    anchors = random_boxes(rng, 4)
+    deltas = jnp.full((4, 4), 100.0)
+    out = np.asarray(decode_boxes(deltas, jnp.asarray(anchors)))
+    assert np.all(np.isfinite(out))
+
+
+def test_clip_boxes():
+    boxes = jnp.asarray([[-5.0, -5.0, 200.0, 50.0]])
+    out = np.asarray(clip_boxes(boxes, 100.0, 150.0))
+    np.testing.assert_allclose(out, [[0.0, 0.0, 150.0, 50.0]])
+
+
+def test_valid_box_mask():
+    boxes = jnp.asarray(
+        [[0, 0, 10, 10], [0, 0, 2, 50], [0, 0, 0, 0]], dtype=jnp.float32
+    )
+    mask = np.asarray(valid_box_mask(boxes, min_size=3.0))
+    np.testing.assert_array_equal(mask, [True, False, False])
+
+
+def test_area():
+    boxes = jnp.asarray([[0, 0, 10, 20]], dtype=jnp.float32)
+    assert float(area(boxes)[0]) == 200.0
+    assert float(area(boxes, legacy_plus_one=True)[0]) == 11 * 21
+
+
+# ---------------- anchors ----------------
+
+
+def test_base_anchors_legacy_matches_canonical():
+    # The canonical 9 anchors from the reference's generate_anchor.py
+    # docstring (base 16, ratios [0.5,1,2], scales [8,16,32]).
+    a = generate_base_anchors(16, (0.5, 1.0, 2.0), (8, 16, 32), legacy_plus_one=True)
+    assert a.shape == (9, 4)
+    np.testing.assert_allclose(a[0], [-84.0, -40.0, 99.0, 55.0])
+    np.testing.assert_allclose(a[3], [-56.0, -56.0, 71.0, 71.0])  # ratio 1 scale 8 -> 128px
+    np.testing.assert_allclose(a[8], [-168.0, -344.0, 183.0, 359.0])  # ratio 2, scale 32
+
+
+def test_base_anchors_modern_areas():
+    a = generate_base_anchors(16, (0.5, 1.0, 2.0), (8,), legacy_plus_one=False)
+    w = a[:, 2] - a[:, 0]
+    h = a[:, 3] - a[:, 1]
+    np.testing.assert_allclose(w * h, [128.0 * 128] * 3, rtol=1e-5)
+    np.testing.assert_allclose(h / w, [0.5, 1.0, 2.0], rtol=1e-5)
+
+
+def test_shifted_anchors_layout():
+    base = jnp.asarray([[0.0, 0.0, 10.0, 10.0], [-5.0, -5.0, 5.0, 5.0]])
+    out = np.asarray(shifted_anchors(base, stride=16, height=2, width=3))
+    assert out.shape == (2 * 3 * 2, 4)
+    # First cell: both base anchors unshifted.
+    np.testing.assert_allclose(out[0], [0, 0, 10, 10])
+    np.testing.assert_allclose(out[1], [-5, -5, 5, 5])
+    # Second cell along width: shifted by stride in x.
+    np.testing.assert_allclose(out[2], [16, 0, 26, 10])
+    # Second row: shifted by stride in y (row-major).
+    np.testing.assert_allclose(out[6], [0, 16, 10, 26])
+
+
+# ---------------- losses ----------------
+
+
+def test_masked_ce_matches_manual():
+    logits = jnp.asarray([[2.0, 1.0], [0.0, 3.0], [5.0, 5.0]])
+    labels = jnp.asarray([0, 1, 0])
+    mask = jnp.asarray([1.0, 1.0, 0.0])
+    got = float(masked_softmax_cross_entropy(logits, labels, mask))
+    p0 = np.exp(2) / (np.exp(2) + np.exp(1))
+    p1 = np.exp(3) / (np.exp(0) + np.exp(3))
+    want = (-np.log(p0) - np.log(p1)) / 2
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_masked_ce_all_invalid_is_zero():
+    logits = jnp.ones((4, 3))
+    labels = jnp.asarray([-1, -1, -1, -1])
+    mask = jnp.zeros(4)
+    assert float(masked_softmax_cross_entropy(logits, labels, mask)) == 0.0
+
+
+def test_smooth_l1_sigma_form():
+    # sigma=3 (the reference's RPN sigma): transition at 1/9.
+    x = jnp.asarray([0.05, 0.5])
+    got = np.asarray(smooth_l1(x, sigma=3.0))
+    np.testing.assert_allclose(got[0], 0.5 * 9 * 0.05**2, rtol=1e-6)
+    np.testing.assert_allclose(got[1], 0.5 - 0.5 / 9, rtol=1e-6)
+
+
+def test_huber_continuity():
+    eps = 1e-4
+    lo = float(huber_loss(jnp.asarray(1.0 - eps), jnp.asarray(0.0)))
+    hi = float(huber_loss(jnp.asarray(1.0 + eps), jnp.asarray(0.0)))
+    assert abs(hi - lo) < 1e-3
+
+
+def test_weighted_smooth_l1_masks_padding():
+    pred = jnp.ones((4, 4))
+    target = jnp.zeros((4, 4))
+    inside = jnp.concatenate([jnp.ones((2, 4)), jnp.zeros((2, 4))])
+    loss = float(weighted_smooth_l1(pred, target, inside, normalizer=2.0))
+    # Each valid element: |1| - 0.5 = 0.5; 8 valid elements / 2.
+    np.testing.assert_allclose(loss, 0.5 * 8 / 2, rtol=1e-6)
