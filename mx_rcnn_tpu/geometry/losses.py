@@ -42,14 +42,15 @@ def masked_softmax_cross_entropy(
 
 
 def huber_loss(pred: jnp.ndarray, target: jnp.ndarray, delta: float = 1.0) -> jnp.ndarray:
-    """Elementwise Huber.  ``delta = 1/sigma^2`` relative to the reference's
-    ``smooth_l1(scalar=sigma)`` parameterization: smooth_l1 with sigma
-    transitions at |x| = 1/sigma^2; huber with delta transitions at |x| =
-    delta, with the quadratic zone scaled to match slope continuity."""
+    """Standard elementwise Huber (optax/torch convention):
+    ``0.5*d^2`` for |d| <= delta, else ``delta*(|d| - 0.5*delta)``.
+    At delta=1 this equals ``smooth_l1(pred - target, sigma=1)``; for other
+    deltas the two families differ in scale — use :func:`smooth_l1` for the
+    reference's sigma parameterization."""
     diff = jnp.abs(pred - target)
-    quad = 0.5 * diff * diff / delta
-    lin = diff - 0.5 * delta
-    return jnp.where(diff < delta, quad, lin)
+    quad = 0.5 * diff * diff
+    lin = delta * (diff - 0.5 * delta)
+    return jnp.where(diff <= delta, quad, lin)
 
 
 def smooth_l1(x: jnp.ndarray, sigma: float = 1.0) -> jnp.ndarray:

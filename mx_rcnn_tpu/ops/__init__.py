@@ -1,0 +1,14 @@
+from mx_rcnn_tpu.ops.nms import batched_nms, nms_mask
+from mx_rcnn_tpu.ops.roi_align import roi_align, multilevel_roi_align
+from mx_rcnn_tpu.ops.proposals import generate_proposals
+from mx_rcnn_tpu.ops.sampling import sample_rois, assign_anchors
+
+__all__ = [
+    "batched_nms",
+    "nms_mask",
+    "roi_align",
+    "multilevel_roi_align",
+    "generate_proposals",
+    "sample_rois",
+    "assign_anchors",
+]

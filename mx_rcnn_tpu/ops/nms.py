@@ -1,0 +1,127 @@
+"""Static-shape non-maximum suppression, fully in-graph.
+
+TPU-native replacement for the reference's three NMS backends
+(``rcnn/processing/nms.py``: py_nms / cpu_nms / gpu_nms and the CUDA
+bitmask kernel ``rcnn/cython/nms_kernel.cu``).  The reference runs NMS on
+the host (or a CUDA kernel) with a device round-trip inside the Proposal
+custom op; here NMS stays inside the jitted step.
+
+Algorithm: score-sort, build the O(N^2) IoU "suppression" matrix (strictly
+upper-triangular: an earlier box can suppress a later one), then iterate
+
+    keep[i] <- not OR_{j<i} (keep[j] AND iou[j, i] > thresh)
+
+to a fixed point with ``lax.while_loop``.  Any fixed point of this map is
+exactly the greedy-NMS solution (induction over i), and the iteration
+finalizes at least one undecided box per sweep, so it terminates in at most
+N sweeps — in practice a handful, each an O(N^2) VPU-friendly masked
+reduction, with no host sync and no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mx_rcnn_tpu.geometry import iou_matrix
+
+
+def nms_mask(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Greedy NMS as a boolean keep-mask in *input* order.
+
+    Args:
+      boxes: (N, 4).
+      scores: (N,) — padded/invalid entries should carry ``-inf`` or use
+        ``valid``.
+      iou_threshold: suppression threshold (reference default 0.7 for RPN
+        proposals, 0.3 at test time).
+      valid: optional (N,) bool; invalid entries never keep nor suppress.
+
+    Returns:
+      (N,) bool keep mask.
+    """
+    n = boxes.shape[0]
+    if valid is None:
+        valid = jnp.isfinite(scores)
+    else:
+        valid = valid & jnp.isfinite(scores)
+
+    order = jnp.argsort(-scores)  # descending; stable for ties
+    sboxes = jnp.take(boxes, order, axis=0)
+    svalid = jnp.take(valid, order)
+
+    iou = iou_matrix(sboxes, sboxes)
+    upper = jnp.triu(jnp.ones((n, n), dtype=bool), k=1)
+    suppress = (iou > iou_threshold) & upper & svalid[:, None] & svalid[None, :]
+
+    def cond(state):
+        keep, prev = state
+        return jnp.any(keep != prev)
+
+    def body(state):
+        keep, _ = state
+        new_keep = svalid & ~jnp.any(suppress & keep[:, None], axis=0)
+        return new_keep, keep
+
+    init = (svalid, jnp.zeros(n, dtype=bool))
+    keep_sorted, _ = lax.while_loop(cond, body, init)
+
+    return jnp.zeros(n, dtype=bool).at[order].set(keep_sorted)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def nms_indices(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    max_outputs: int,
+    valid: jnp.ndarray | None = None,
+):
+    """NMS returning up to ``max_outputs`` kept indices, score-descending.
+
+    Static output shape: ``(indices (max_outputs,), out_valid (max_outputs,))``.
+    Padded slots hold index 0 with ``out_valid`` False — the static-shape
+    replacement for the reference Proposal op's pad-with-repeats
+    (``rcnn/symbol/proposal.py`` pads rois to RPN_POST_NMS_TOP_N).
+    """
+    n = boxes.shape[0]
+    keep = nms_mask(boxes, scores, iou_threshold, valid)
+    # Rank kept entries by score; drop the rest to the tail.
+    neg = jnp.where(keep, -scores, jnp.inf)
+    order = jnp.argsort(neg)  # kept entries first, best score first
+    k = min(n, max_outputs)
+    idx = order[:k]
+    kept = jnp.take(keep, idx)
+    if k < max_outputs:
+        pad = max_outputs - k
+        idx = jnp.concatenate([idx, jnp.zeros(pad, idx.dtype)])
+        kept = jnp.concatenate([kept, jnp.zeros(pad, bool)])
+    out_valid = kept & (jnp.arange(max_outputs) < jnp.sum(keep))
+    return jnp.where(out_valid, idx, 0), out_valid
+
+
+def batched_nms(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    classes: jnp.ndarray,
+    iou_threshold: float,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-class NMS in one shot via the coordinate-offset trick.
+
+    Boxes of different classes are translated to disjoint regions so they
+    can never overlap; one NMS pass then equals independent per-class NMS.
+    Replaces the reference's per-class python loop in
+    ``rcnn/core/tester.py::pred_eval``.
+    """
+    span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+    offset = classes.astype(boxes.dtype)[:, None] * span
+    return nms_mask(boxes + offset, scores, iou_threshold, valid)

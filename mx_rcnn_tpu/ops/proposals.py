@@ -1,0 +1,119 @@
+"""In-graph RPN proposal generation.
+
+Replaces the reference Proposal custom op (``rcnn/symbol/proposal.py``,
+and the engine's ``mx.contrib.symbol.Proposal`` behind CXX_PROPOSAL):
+decode RPN outputs into scored boxes, pre-NMS top-k, NMS, and emit a fixed
+``post_nms_top_n`` roi set — with zero host interaction.  The reference
+pays a device->host->device round-trip plus a CUDA NMS here every
+iteration (SURVEY.md section 4.5); this version is one fused XLA region.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from mx_rcnn_tpu.geometry import clip_boxes, decode_boxes, valid_box_mask
+from mx_rcnn_tpu.ops.nms import nms_indices
+
+
+class Proposals(NamedTuple):
+    rois: jnp.ndarray    # (post_nms_top_n, 4)
+    scores: jnp.ndarray  # (post_nms_top_n,)
+    valid: jnp.ndarray   # (post_nms_top_n,) bool
+
+
+def generate_proposals(
+    scores: jnp.ndarray,
+    deltas: jnp.ndarray,
+    anchors: jnp.ndarray,
+    image_height,
+    image_width,
+    pre_nms_top_n: int = 6000,
+    post_nms_top_n: int = 300,
+    nms_threshold: float = 0.7,
+    min_size: float = 0.0,
+) -> Proposals:
+    """Single-level proposal generation.
+
+    Args:
+      scores: (A,) objectness probabilities (post-sigmoid/softmax-fg).
+      deltas: (A, 4) RPN regression output.
+      anchors: (A, 4) matching anchor boxes.
+      image_height/image_width: true (unpadded) image extent, may be traced.
+      pre_nms_top_n / post_nms_top_n / nms_threshold / min_size: the
+        reference's RPN_PRE_NMS_TOP_N / RPN_POST_NMS_TOP_N /
+        config.TRAIN.RPN_NMS_THRESH / RPN_MIN_SIZE.
+
+    Returns:
+      Fixed-size Proposals; invalid slots carry zeros.
+    """
+    a = scores.shape[0]
+    k = min(pre_nms_top_n, a)
+
+    top_scores, top_idx = lax.top_k(scores, k)
+    boxes = decode_boxes(
+        jnp.take(deltas, top_idx, axis=0), jnp.take(anchors, top_idx, axis=0)
+    )
+    boxes = clip_boxes(boxes, image_height, image_width)
+
+    ok = valid_box_mask(boxes, min_size=min_size)
+    masked_scores = jnp.where(ok, top_scores, -jnp.inf)
+
+    keep_idx, keep_valid = nms_indices(
+        boxes, masked_scores, nms_threshold, post_nms_top_n
+    )
+    rois = jnp.take(boxes, keep_idx, axis=0) * keep_valid[:, None]
+    out_scores = jnp.where(keep_valid, jnp.take(masked_scores, keep_idx), 0.0)
+    return Proposals(rois=rois, scores=out_scores, valid=keep_valid)
+
+
+def generate_fpn_proposals(
+    level_scores: dict[int, jnp.ndarray],
+    level_deltas: dict[int, jnp.ndarray],
+    level_anchors: dict[int, jnp.ndarray],
+    image_height,
+    image_width,
+    pre_nms_top_n: int = 2000,
+    post_nms_top_n: int = 1000,
+    nms_threshold: float = 0.7,
+    min_size: float = 0.0,
+) -> Proposals:
+    """FPN-style proposals: per-level top-k + NMS, then global top-k by score.
+
+    (Detectron recipe: PRE_NMS_TOPK per level, POST_NMS_TOPK across the
+    union — the configuration the BASELINE north star's >=37 mAP requires.)
+    """
+    per_level = []
+    # Detectron recipe: each level may keep up to post_nms_top_n proposals;
+    # the global top-k over the union then trims to post_nms_top_n total.
+    for lvl in sorted(level_scores.keys()):
+        p = generate_proposals(
+            level_scores[lvl],
+            level_deltas[lvl],
+            level_anchors[lvl],
+            image_height,
+            image_width,
+            pre_nms_top_n=pre_nms_top_n,
+            post_nms_top_n=post_nms_top_n,
+            nms_threshold=nms_threshold,
+            min_size=min_size,
+        )
+        per_level.append(p)
+
+    rois = jnp.concatenate([p.rois for p in per_level], axis=0)
+    scores = jnp.concatenate([p.scores for p in per_level], axis=0)
+    valid = jnp.concatenate([p.valid for p in per_level], axis=0)
+
+    masked = jnp.where(valid, scores, -jnp.inf)
+    k = min(post_nms_top_n, rois.shape[0])
+    top_scores, top_idx = lax.top_k(masked, k)
+    out_valid = jnp.isfinite(top_scores)
+    out_rois = jnp.take(rois, top_idx, axis=0) * out_valid[:, None]
+    return Proposals(
+        rois=out_rois,
+        scores=jnp.where(out_valid, top_scores, 0.0),
+        valid=out_valid,
+    )

@@ -1,0 +1,152 @@
+"""ROIAlign, XLA-native (gather + bilinear), with multilevel FPN dispatch.
+
+Replaces the engine-side ``mx.symbol.ROIPooling`` CUDA op the reference's
+R-CNN head depends on (SURVEY.md section 3.5), upgraded to ROIAlign per the
+BASELINE north star.  Design notes for TPU:
+
+- All shapes static: (R rois) x (S x S bins) x (sr x sr samples/bin).
+- The bilinear gather is expressed as 4 corner gathers from the flattened
+  (H*W, C) feature map with computed flat indices — XLA lowers this to
+  dynamic-gather, which is the memory-bound but correct baseline; the
+  Pallas kernel (ops/pallas/roi_align.py) is the performance path.
+- Sample points are accumulated one at a time (sr*sr iterations, unrolled
+  at trace time) so the peak intermediate is (R, S, S, C), not
+  (R, S*sr, S*sr, C).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def roi_align(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    spatial_scale: float = 1.0 / 16.0,
+    sampling_ratio: int = 2,
+) -> jnp.ndarray:
+    """ROIAlign on a single feature map.
+
+    Args:
+      features: (H, W, C) feature map.
+      rois: (R, 4) boxes in input-image coordinates (x1, y1, x2, y2).
+      output_size: S — pooled bins per side (7 for box head, 14 for mask).
+      spatial_scale: 1/stride of this feature map.
+      sampling_ratio: sr — bilinear samples per bin side.
+
+    Returns:
+      (R, S, S, C) pooled features.
+    """
+    h, w, c = features.shape
+    flat = features.reshape(h * w, c)
+
+    scaled = rois * spatial_scale
+    x1, y1 = scaled[:, 0], scaled[:, 1]
+    rw = jnp.maximum(scaled[:, 2] - x1, 1.0)
+    rh = jnp.maximum(scaled[:, 3] - y1, 1.0)
+    bin_w = rw / output_size  # (R,)
+    bin_h = rh / output_size
+
+    bins = jnp.arange(output_size, dtype=features.dtype)  # (S,)
+
+    out = jnp.zeros((rois.shape[0], output_size, output_size, c), features.dtype)
+    for iy in range(sampling_ratio):
+        fy = (iy + 0.5) / sampling_ratio
+        # (R, S): absolute y of this sample row in every bin
+        sy = y1[:, None] + (bins[None, :] + fy) * bin_h[:, None]
+        for ix in range(sampling_ratio):
+            fx = (ix + 0.5) / sampling_ratio
+            sx = x1[:, None] + (bins[None, :] + fx) * bin_w[:, None]
+            out = out + _bilinear_gather(flat, h, w, sy, sx)
+    return out / (sampling_ratio * sampling_ratio)
+
+
+def _bilinear_gather(flat, h, w, sy, sx):
+    """Bilinear sample at (sy (R,S), sx (R,S)) -> (R, S, S, C).
+
+    Out-of-range samples (beyond one pixel outside the map, matching
+    Detectron ROIAlign semantics) contribute zero.
+    """
+    inside = (
+        (sy[:, :, None] > -1.0)
+        & (sy[:, :, None] < h)
+        & (sx[:, None, :] > -1.0)
+        & (sx[:, None, :] < w)
+    )  # (R, S, S)
+
+    y = jnp.clip(sy, 0.0, h - 1)  # (R, S)
+    x = jnp.clip(sx, 0.0, w - 1)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    ly = y - y0  # (R, S)
+    lx = x - x0
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+    y1i = jnp.minimum(y0i + 1, h - 1)
+    x1i = jnp.minimum(x0i + 1, w - 1)
+
+    def gather(yi, xi):  # yi (R,S), xi (R,S) -> (R, S, S, C)
+        idx = yi[:, :, None] * w + xi[:, None, :]  # (R, S, S)
+        return jnp.take(flat, idx.reshape(-1), axis=0).reshape(*idx.shape, -1)
+
+    wy0 = (1.0 - ly)[:, :, None, None]
+    wy1 = ly[:, :, None, None]
+    wx0 = (1.0 - lx)[:, None, :, None]
+    wx1 = lx[:, None, :, None]
+
+    val = (
+        gather(y0i, x0i) * wy0 * wx0
+        + gather(y0i, x1i) * wy0 * wx1
+        + gather(y1i, x0i) * wy1 * wx0
+        + gather(y1i, x1i) * wy1 * wx1
+    )
+    return val * inside[..., None]
+
+
+def fpn_level_assignment(
+    rois: jnp.ndarray,
+    min_level: int = 2,
+    max_level: int = 5,
+    canonical_scale: float = 224.0,
+    canonical_level: int = 4,
+) -> jnp.ndarray:
+    """FPN paper eq. 1: level k = k0 + log2(sqrt(area)/224), clamped."""
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 1e-6)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 1e-6)
+    k = canonical_level + jnp.log2(jnp.sqrt(w * h) / canonical_scale)
+    return jnp.clip(jnp.floor(k).astype(jnp.int32), min_level, max_level)
+
+
+def multilevel_roi_align(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+) -> jnp.ndarray:
+    """ROIAlign over an FPN pyramid with per-roi level assignment.
+
+    ``feature_pyramid`` maps level -> (H_l, W_l, C); stride of level l is
+    2**l.  Every roi is pooled from every level and the per-roi one-hot
+    level indicator selects the result — 4x redundant compute but fully
+    static shapes and no host interaction; the Pallas path will gather
+    per-level instead.
+    """
+    levels = sorted(feature_pyramid.keys())
+    assignment = fpn_level_assignment(rois, min_level=levels[0], max_level=levels[-1])
+    out = None
+    for lvl in levels:
+        pooled = roi_align(
+            feature_pyramid[lvl],
+            rois,
+            output_size=output_size,
+            spatial_scale=1.0 / (2**lvl),
+            sampling_ratio=sampling_ratio,
+        )
+        sel = (assignment == lvl).astype(pooled.dtype)[:, None, None, None]
+        out = pooled * sel if out is None else out + pooled * sel
+    return out

@@ -1,0 +1,204 @@
+"""In-graph target assignment and sampling.
+
+Replaces two host-side components of the reference with static-shape,
+rng-keyed, jit-safe functions:
+
+- ``rcnn/io/rpn.py::assign_anchor`` (RPN anchor labeling + subsampling,
+  run on the host by the data loader every batch) -> :func:`assign_anchors`.
+- ``rcnn/symbol/proposal_target.py::ProposalTargetOperator`` +
+  ``rcnn/io/rcnn.py::sample_rois`` (the device->host->device CustomOp in
+  the middle of the train graph) -> :func:`sample_rois`.
+
+Random subsampling with *fixed output shapes* uses the randomized-rank
+trick: candidates get iid uniform priorities; "choose n of k" becomes
+"rank < n" over the priorities, where n is a traced scalar.  No dynamic
+shapes, no host RNG, reproducible from a jax PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.geometry import encode_boxes, iou_matrix
+
+
+def _random_rank(key: jax.Array, candidate: jnp.ndarray) -> jnp.ndarray:
+    """Rank of each element among candidates under a random permutation.
+
+    Non-candidates rank after all candidates.  rank is 0-based: selecting
+    ``rank < n`` picks n uniform-random candidates.
+    """
+    pri = jax.random.uniform(key, candidate.shape)
+    pri = jnp.where(candidate, pri, 2.0)  # non-candidates sort last
+    order = jnp.argsort(pri)
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    return ranks
+
+
+class AnchorTargets(NamedTuple):
+    labels: jnp.ndarray        # (A,) int32: 1 fg, 0 bg, -1 ignore
+    bbox_targets: jnp.ndarray  # (A, 4) encode of matched gt (fg rows only meaningful)
+    fg_mask: jnp.ndarray       # (A,) bool
+    valid_mask: jnp.ndarray    # (A,) bool: labels != -1 (loss-contributing)
+
+
+def assign_anchors(
+    key: jax.Array,
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    image_height,
+    image_width,
+    batch_size: int = 256,
+    fg_fraction: float = 0.5,
+    positive_iou: float = 0.7,
+    negative_iou: float = 0.3,
+    allowed_border: float = 0.0,
+) -> AnchorTargets:
+    """Label anchors for RPN training (reference assign_anchor semantics).
+
+    - anchors crossing the image boundary (by more than ``allowed_border``)
+      are ignored;
+    - fg: IoU >= positive_iou with some gt, PLUS every gt's best anchor
+      (so each gt gets at least one positive even below the threshold);
+    - bg: max IoU < negative_iou;
+    - subsample to ``batch_size`` with at most ``fg_fraction`` positives;
+      leftover fg quota is given to bg (reference behavior).
+
+    ``gt_boxes`` is padded to a static G with ``gt_valid`` masking.
+    """
+    a = anchors.shape[0]
+    inside = (
+        (anchors[:, 0] >= -allowed_border)
+        & (anchors[:, 1] >= -allowed_border)
+        & (anchors[:, 2] < image_width + allowed_border)
+        & (anchors[:, 3] < image_height + allowed_border)
+    )
+
+    iou = iou_matrix(anchors, gt_boxes)  # (A, G)
+    iou = iou * gt_valid[None, :].astype(iou.dtype)
+    max_iou = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+
+    # Per-gt best anchors (with ties, like the reference's gt_argmax trick).
+    # Restricted to INSIDE anchors — the reference filters to inside anchors
+    # before the gt-argmax step, so a gt near the border still gets its best
+    # in-bounds anchor as a positive.
+    any_gt = jnp.any(gt_valid)
+    iou_inside = iou * inside[:, None].astype(iou.dtype)
+    gt_best = jnp.max(iou_inside, axis=0)  # (G,)
+    is_gt_best = jnp.any(
+        (iou_inside == gt_best[None, :]) & gt_valid[None, :] & (gt_best[None, :] > 0.0),
+        axis=1,
+    )
+
+    fg_cand = inside & any_gt & ((max_iou >= positive_iou) | is_gt_best)
+    bg_cand = inside & (max_iou < negative_iou) & ~fg_cand
+
+    num_fg_quota = int(batch_size * fg_fraction)
+    k_fg, k_bg = jax.random.split(key)
+    fg_rank = _random_rank(k_fg, fg_cand)
+    n_fg = jnp.minimum(num_fg_quota, jnp.sum(fg_cand))
+    fg = fg_cand & (fg_rank < n_fg)
+
+    bg_rank = _random_rank(k_bg, bg_cand)
+    n_bg = jnp.minimum(batch_size - n_fg, jnp.sum(bg_cand))
+    bg = bg_cand & (bg_rank < n_bg)
+
+    labels = jnp.full((a,), -1, dtype=jnp.int32)
+    labels = jnp.where(bg, 0, labels)
+    labels = jnp.where(fg, 1, labels)
+
+    matched = jnp.take(gt_boxes, argmax_gt, axis=0)  # (A, 4)
+    bbox_targets = encode_boxes(matched, anchors)
+    bbox_targets = jnp.where(fg[:, None], bbox_targets, 0.0)
+
+    return AnchorTargets(
+        labels=labels,
+        bbox_targets=bbox_targets,
+        fg_mask=fg,
+        valid_mask=labels >= 0,
+    )
+
+
+class RoiSamples(NamedTuple):
+    rois: jnp.ndarray          # (B, 4)
+    labels: jnp.ndarray        # (B,) int32 class ids (0 = background)
+    label_weights: jnp.ndarray # (B,) 1.0 for real samples, 0.0 for padding
+    bbox_targets: jnp.ndarray  # (B, 4) encoded vs the roi (fg rows only)
+    fg_mask: jnp.ndarray       # (B,) bool
+
+
+def sample_rois(
+    key: jax.Array,
+    rois: jnp.ndarray,
+    roi_valid: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_classes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    batch_size: int = 512,
+    fg_fraction: float = 0.25,
+    fg_iou: float = 0.5,
+    bg_iou_hi: float = 0.5,
+    bg_iou_lo: float = 0.0,
+    bbox_weights: tuple[float, float, float, float] = (10.0, 10.0, 5.0, 5.0),
+) -> RoiSamples:
+    """Sample proposals into a fixed R-CNN minibatch with targets.
+
+    Mirrors ProposalTargetOperator: gt boxes are appended to the proposal
+    set (guaranteeing clean positives early in training), rois are matched
+    to gt by IoU, and a fixed-size batch is drawn at ``fg_fraction``.  Where
+    the reference resamples with replacement to fill the quota, we emit
+    zero-weight padding slots and normalize losses by the valid count —
+    equivalent in expectation, shape-static, and bias-free.
+
+    ``bbox_weights`` is 1/std of the reference's ``TRAIN.BBOX_NORMALIZATION``
+    (targets scaled in-graph; the head's predictions are unscaled at decode).
+    """
+    all_rois = jnp.concatenate([rois, gt_boxes], axis=0)  # (R+G, 4)
+    all_valid = jnp.concatenate([roi_valid, gt_valid], axis=0)
+
+    iou = iou_matrix(all_rois, gt_boxes) * gt_valid[None, :].astype(rois.dtype)
+    max_iou = jnp.where(all_valid, jnp.max(iou, axis=1), -1.0)
+    argmax_gt = jnp.argmax(iou, axis=1)
+
+    fg_cand = all_valid & (max_iou >= fg_iou)
+    bg_cand = all_valid & (max_iou < bg_iou_hi) & (max_iou >= bg_iou_lo) & ~fg_cand
+
+    num_fg_quota = int(batch_size * fg_fraction)
+    k_fg, k_bg = jax.random.split(key)
+    fg_rank = _random_rank(k_fg, fg_cand)
+    n_fg = jnp.minimum(num_fg_quota, jnp.sum(fg_cand))
+    fg_sel = fg_cand & (fg_rank < n_fg)
+
+    bg_rank = _random_rank(k_bg, bg_cand)
+    n_bg = jnp.minimum(batch_size - n_fg, jnp.sum(bg_cand))
+    bg_sel = bg_cand & (bg_rank < n_bg)
+
+    # Compact selected rois into the fixed batch: fg block, then bg block,
+    # then zero-weight padding.  Selection priority is monotone-decreasing,
+    # so one argsort produces the gather order.
+    pri = jnp.where(fg_sel, 3.0e9 - fg_rank, jnp.where(bg_sel, 1.0e9 - bg_rank, -1.0))
+    order = jnp.argsort(-pri)[:batch_size]
+    picked = jnp.take(pri, order) > 0.0  # (B,) real sample?
+
+    out_rois = jnp.take(all_rois, order, axis=0)
+    out_fg = jnp.take(fg_sel, order)
+    matched_gt = jnp.take(argmax_gt, order)
+    cls = jnp.take(gt_classes, matched_gt)
+    labels = jnp.where(out_fg, cls, 0).astype(jnp.int32)
+
+    matched_boxes = jnp.take(gt_boxes, matched_gt, axis=0)
+    targets = encode_boxes(matched_boxes, out_rois, weights=bbox_weights)
+    targets = jnp.where(out_fg[:, None], targets, 0.0)
+
+    return RoiSamples(
+        rois=out_rois,
+        labels=labels,
+        label_weights=picked.astype(jnp.float32),
+        bbox_targets=targets,
+        fg_mask=out_fg,
+    )

@@ -21,7 +21,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402  (safe: importing jax does not init backends)
 from jax._src import xla_bridge as _xb  # noqa: E402
 
-for _name in list(getattr(_xb, "_backend_factories", {})):
+# Fail loudly if a jax upgrade moves this private dict — a silent no-op here
+# would bring back the CI hang this guard exists to prevent.
+assert isinstance(_xb._backend_factories, dict), "jax moved _backend_factories"
+for _name in list(_xb._backend_factories):
     if _name not in ("cpu", "tpu"):
         _xb._backend_factories.pop(_name, None)
 

@@ -1,0 +1,353 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.geometry import encode_boxes, generate_base_anchors, shifted_anchors
+from mx_rcnn_tpu.ops import (
+    assign_anchors,
+    batched_nms,
+    generate_proposals,
+    multilevel_roi_align,
+    nms_mask,
+    roi_align,
+    sample_rois,
+)
+from mx_rcnn_tpu.ops.nms import nms_indices
+from mx_rcnn_tpu.ops.roi_align import fpn_level_assignment
+
+from oracles import greedy_nms_np, roi_align_np
+
+
+def random_boxes(rng, n, size=100.0):
+    xy = rng.uniform(0, size * 0.7, (n, 2))
+    wh = rng.uniform(2, size * 0.3, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+# ---------------- NMS ----------------
+
+
+@pytest.mark.parametrize("n,thresh", [(20, 0.5), (100, 0.3), (100, 0.7), (257, 0.5)])
+def test_nms_matches_greedy_oracle(rng, n, thresh):
+    boxes = random_boxes(rng, n)
+    scores = rng.uniform(0, 1, n).astype(np.float32)
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores), thresh))
+    want = np.zeros(n, dtype=bool)
+    want[greedy_nms_np(boxes, scores, thresh)] = True
+    np.testing.assert_array_equal(keep, want)
+
+
+def test_nms_identical_boxes_keeps_best():
+    boxes = jnp.asarray([[0, 0, 10, 10]] * 5, dtype=jnp.float32)
+    scores = jnp.asarray([0.1, 0.9, 0.5, 0.3, 0.7])
+    keep = np.asarray(nms_mask(boxes, scores, 0.5))
+    np.testing.assert_array_equal(keep, [False, True, False, False, False])
+
+
+def test_nms_invalid_entries_never_keep_or_suppress(rng):
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]], np.float32)
+    scores = np.asarray([0.9, 0.5, 0.8], np.float32)
+    # Entry 0 invalid: should not suppress entry 1; entry 2 should suppress 1.
+    valid = jnp.asarray([False, True, True])
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores), 0.5, valid))
+    np.testing.assert_array_equal(keep, [False, False, True])
+
+
+def test_nms_neg_inf_scores_are_invalid():
+    boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], dtype=jnp.float32)
+    scores = jnp.asarray([-jnp.inf, 0.5])
+    keep = np.asarray(nms_mask(boxes, scores, 0.5))
+    np.testing.assert_array_equal(keep, [False, True])
+
+
+def test_nms_indices_padding(rng):
+    boxes = random_boxes(rng, 30)
+    scores = rng.uniform(0, 1, 30).astype(np.float32)
+    idx, valid = nms_indices(jnp.asarray(boxes), jnp.asarray(scores), 0.5, 50)
+    idx, valid = np.asarray(idx), np.asarray(valid)
+    n_kept = len(greedy_nms_np(boxes, scores, 0.5))
+    assert valid.sum() == n_kept
+    assert idx.shape == (50,)
+    # Valid indices sorted by descending score.
+    s = scores[idx[valid]]
+    assert np.all(np.diff(s) <= 0)
+    # Padded slots are 0/False.
+    assert np.all(idx[~valid] == 0)
+
+
+def test_batched_nms_is_per_class(rng):
+    # Two perfectly overlapping boxes, different classes: both kept.
+    boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10]], dtype=jnp.float32)
+    scores = jnp.asarray([0.9, 0.8])
+    classes = jnp.asarray([1, 2])
+    keep = np.asarray(batched_nms(boxes, scores, classes, 0.5))
+    np.testing.assert_array_equal(keep, [True, True])
+    # Same class: one suppressed.
+    keep2 = np.asarray(batched_nms(boxes, scores, jnp.asarray([1, 1]), 0.5))
+    np.testing.assert_array_equal(keep2, [True, False])
+
+
+def test_nms_jit_no_retrace(rng):
+    boxes = jnp.asarray(random_boxes(rng, 64))
+    scores = jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32))
+    f = jax.jit(lambda b, s: nms_mask(b, s, 0.5))
+    f(boxes, scores).block_until_ready()
+    n0 = f._cache_size()
+    f(boxes, scores + 0.01).block_until_ready()
+    assert f._cache_size() == n0
+
+
+# ---------------- ROIAlign ----------------
+
+
+def test_roi_align_matches_oracle(rng):
+    feat = rng.rand(16, 16, 3).astype(np.float32)
+    rois = np.asarray(
+        [[8.0, 8.0, 100.0, 120.0], [0.0, 0.0, 64.0, 64.0], [40.0, 30.0, 200.0, 220.0]],
+        np.float32,
+    )
+    got = np.asarray(roi_align(jnp.asarray(feat), jnp.asarray(rois), 7, 1 / 16.0, 2))
+    want = roi_align_np(feat, rois, 7, 1 / 16.0, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_constant_map(rng):
+    # Pooling a constant feature map must return the constant everywhere
+    # the roi is in-bounds.
+    feat = jnp.full((20, 20, 4), 3.5)
+    rois = jnp.asarray([[16.0, 16.0, 160.0, 160.0]])
+    out = np.asarray(roi_align(feat, rois, 7, 1 / 16.0, 2))
+    np.testing.assert_allclose(out, 3.5, rtol=1e-6)
+
+
+def test_roi_align_gradient_flows(rng):
+    feat = jnp.asarray(rng.rand(10, 10, 2).astype(np.float32))
+    rois = jnp.asarray([[10.0, 10.0, 80.0, 80.0]])
+
+    def f(x):
+        return roi_align(x, rois, 7, 1 / 16.0, 2).sum()
+
+    g = jax.grad(f)(feat)
+    assert bool(jnp.isfinite(g).all())
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_fpn_level_assignment():
+    rois = jnp.asarray(
+        [
+            [0, 0, 56, 56],     # small -> level 2
+            [0, 0, 224, 224],   # canonical -> level 4
+            [0, 0, 896, 896],   # huge -> clamped to 5
+            [0, 0, 10, 10],     # tiny -> clamped to 2
+        ],
+        dtype=jnp.float32,
+    )
+    lv = np.asarray(fpn_level_assignment(rois))
+    np.testing.assert_array_equal(lv, [2, 4, 5, 2])
+
+
+def test_multilevel_roi_align_selects_level(rng):
+    # Make each level a distinct constant; the output constant identifies
+    # which level was pooled.
+    pyramid = {l: jnp.full((32, 32, 1), float(l)) for l in (2, 3, 4, 5)}
+    rois = jnp.asarray([[0, 0, 56, 56], [0, 0, 224, 224], [0, 0, 896, 896]])
+    out = np.asarray(multilevel_roi_align(pyramid, rois, output_size=2))
+    np.testing.assert_allclose(out[0], 2.0)
+    np.testing.assert_allclose(out[1], 4.0)
+    np.testing.assert_allclose(out[2], 5.0)
+
+
+# ---------------- proposals ----------------
+
+
+def _rpn_inputs(rng, h=10, w=12):
+    base = generate_base_anchors(16, (0.5, 1.0, 2.0), (8,))
+    anchors = shifted_anchors(jnp.asarray(base), 16, h, w)
+    a = anchors.shape[0]
+    scores = jnp.asarray(rng.uniform(0, 1, a).astype(np.float32))
+    deltas = jnp.asarray(rng.normal(0, 0.1, (a, 4)).astype(np.float32))
+    return anchors, scores, deltas
+
+
+def test_generate_proposals_shapes_and_validity(rng):
+    anchors, scores, deltas = _rpn_inputs(rng)
+    p = generate_proposals(scores, deltas, anchors, 160.0, 192.0,
+                           pre_nms_top_n=200, post_nms_top_n=50, nms_threshold=0.7)
+    assert p.rois.shape == (50, 4)
+    assert p.valid.shape == (50,)
+    assert int(p.valid.sum()) > 0
+    rois = np.asarray(p.rois)[np.asarray(p.valid)]
+    assert (rois[:, 0] >= 0).all() and (rois[:, 2] <= 192).all()
+    assert (rois[:, 1] >= 0).all() and (rois[:, 3] <= 160).all()
+    # Scores descending among valid.
+    s = np.asarray(p.scores)[np.asarray(p.valid)]
+    assert np.all(np.diff(s) <= 0)
+
+
+def test_generate_proposals_respects_min_size(rng):
+    anchors, scores, deltas = _rpn_inputs(rng)
+    # Huge min_size: nothing survives.
+    p = generate_proposals(scores, deltas, anchors, 160.0, 192.0,
+                           pre_nms_top_n=100, post_nms_top_n=20, min_size=1000.0)
+    assert int(p.valid.sum()) == 0
+    assert np.all(np.asarray(p.rois) == 0)
+
+
+def test_generate_proposals_all_in_one_jit(rng):
+    anchors, scores, deltas = _rpn_inputs(rng)
+
+    @jax.jit
+    def f(s, d):
+        return generate_proposals(s, d, anchors, 160.0, 192.0,
+                                  pre_nms_top_n=100, post_nms_top_n=20)
+
+    p = f(scores, deltas)
+    assert p.rois.shape == (20, 4)
+
+
+# ---------------- assign_anchors ----------------
+
+
+def test_assign_anchors_basic(rng):
+    base = generate_base_anchors(16, (0.5, 1.0, 2.0), (2, 4))
+    anchors = shifted_anchors(jnp.asarray(base), 16, 12, 12)
+    gt = jnp.asarray([[30.0, 30.0, 80.0, 90.0], [0.0, 0.0, 0.0, 0.0]])
+    gt_valid = jnp.asarray([True, False])
+    t = assign_anchors(jax.random.key(0), anchors, gt, gt_valid, 192.0, 192.0,
+                       batch_size=64, fg_fraction=0.5)
+    labels = np.asarray(t.labels)
+    assert (labels == 1).sum() >= 1
+    assert (labels == 1).sum() <= 32
+    assert (labels >= 0).sum() <= 64
+    # All fg anchors overlap the gt box decently.
+    from oracles import iou_matrix_np
+
+    fg_anchors = np.asarray(anchors)[labels == 1]
+    ious = iou_matrix_np(fg_anchors, np.asarray(gt[:1]))
+    assert ious.max(axis=1).min() > 0.1
+
+
+def test_assign_anchors_best_anchor_is_fg_even_below_thresh(rng):
+    # One tiny gt that no anchor reaches 0.7 IoU with: its best anchor must
+    # still be labeled fg (reference gt_argmax behavior).
+    base = generate_base_anchors(16, (1.0,), (2,))
+    anchors = shifted_anchors(jnp.asarray(base), 16, 8, 8)
+    gt = jnp.asarray([[33.0, 33.0, 50.0, 52.0]])
+    t = assign_anchors(jax.random.key(1), anchors, gt, jnp.asarray([True]),
+                       128.0, 128.0, batch_size=32)
+    assert int(t.fg_mask.sum()) >= 1
+
+
+def test_assign_anchors_border_gt_still_gets_positive():
+    # gt in the image corner whose globally-best anchor crosses the border:
+    # the best INSIDE anchor must be fg (reference computes gt-argmax over
+    # inside anchors only).
+    base = generate_base_anchors(16, (1.0,), (2,))  # 32px anchors
+    anchors = shifted_anchors(jnp.asarray(base), 16, 4, 4)  # 64px image
+    gt = jnp.asarray([[44.0, 44.0, 63.0, 63.0]])
+    t = assign_anchors(jax.random.key(0), anchors, gt, jnp.asarray([True]),
+                       64.0, 64.0, batch_size=32)
+    assert int(t.fg_mask.sum()) >= 1
+
+
+def test_assign_anchors_outside_ignored():
+    base = generate_base_anchors(16, (1.0,), (8,))  # 128px anchors on 64px image
+    anchors = shifted_anchors(jnp.asarray(base), 16, 4, 4)
+    gt = jnp.asarray([[10.0, 10.0, 50.0, 50.0]])
+    t = assign_anchors(jax.random.key(2), anchors, gt, jnp.asarray([True]),
+                       64.0, 64.0, batch_size=32)
+    # Every anchor crosses the boundary -> everything ignored.
+    assert int(t.valid_mask.sum()) == 0
+
+
+def test_assign_anchors_no_gt_all_bg():
+    base = generate_base_anchors(16, (1.0,), (1,))
+    anchors = shifted_anchors(jnp.asarray(base), 16, 6, 6)
+    gt = jnp.zeros((2, 4))
+    t = assign_anchors(jax.random.key(3), anchors, gt, jnp.asarray([False, False]),
+                       96.0, 96.0, batch_size=16)
+    labels = np.asarray(t.labels)
+    assert (labels == 1).sum() == 0
+    assert (labels == 0).sum() == 16  # all sampled slots are bg
+
+
+def test_assign_anchors_deterministic_per_key(rng):
+    base = generate_base_anchors(16, (0.5, 1.0), (2, 4))
+    anchors = shifted_anchors(jnp.asarray(base), 16, 10, 10)
+    gt = jnp.asarray([[20.0, 20.0, 90.0, 100.0]])
+    gv = jnp.asarray([True])
+    t1 = assign_anchors(jax.random.key(7), anchors, gt, gv, 160.0, 160.0)
+    t2 = assign_anchors(jax.random.key(7), anchors, gt, gv, 160.0, 160.0)
+    np.testing.assert_array_equal(np.asarray(t1.labels), np.asarray(t2.labels))
+
+
+# ---------------- sample_rois ----------------
+
+
+def _roi_setup(rng, n_rois=100):
+    gt = jnp.asarray([[10.0, 10.0, 50.0, 60.0], [70.0, 20.0, 120.0, 90.0],
+                      [0.0, 0.0, 0.0, 0.0]])
+    gt_classes = jnp.asarray([3, 7, 0], dtype=jnp.int32)
+    gt_valid = jnp.asarray([True, True, False])
+    rois = jnp.asarray(random_boxes(rng, n_rois, 130.0))
+    roi_valid = jnp.ones(n_rois, dtype=bool)
+    return rois, roi_valid, gt, gt_classes, gt_valid
+
+
+def test_sample_rois_composition(rng):
+    rois, rv, gt, gc, gv = _roi_setup(rng)
+    s = sample_rois(jax.random.key(0), rois, rv, gt, gc, gv,
+                    batch_size=64, fg_fraction=0.25)
+    assert s.rois.shape == (64, 4)
+    n_fg = int(s.fg_mask.sum())
+    assert 1 <= n_fg <= 16
+    labels = np.asarray(s.labels)
+    w = np.asarray(s.label_weights)
+    # fg labels are real classes; bg labels are 0.
+    assert set(labels[np.asarray(s.fg_mask)]).issubset({3, 7})
+    assert (labels[(w > 0) & ~np.asarray(s.fg_mask)] == 0).all()
+    # fg slots come first.
+    fg = np.asarray(s.fg_mask)
+    assert fg[: n_fg].all() and not fg[n_fg:].any()
+
+
+def test_sample_rois_gt_appended_guarantees_fg(rng):
+    # Proposals nowhere near gt: the appended gt boxes still provide fg.
+    gt = jnp.asarray([[10.0, 10.0, 50.0, 60.0]])
+    rois = jnp.asarray([[200.0, 200.0, 250.0, 260.0]] * 10, dtype=jnp.float32)
+    s = sample_rois(jax.random.key(0), rois, jnp.ones(10, bool), gt,
+                    jnp.asarray([5], jnp.int32), jnp.asarray([True]),
+                    batch_size=16, fg_fraction=0.5)
+    assert int(s.fg_mask.sum()) == 1
+    got_roi = np.asarray(s.rois)[np.asarray(s.fg_mask)][0]
+    np.testing.assert_allclose(got_roi, [10, 10, 50, 60])
+    assert np.asarray(s.labels)[np.asarray(s.fg_mask)][0] == 5
+
+
+def test_sample_rois_bbox_targets_decode_back(rng):
+    rois, rv, gt, gc, gv = _roi_setup(rng)
+    w = (10.0, 10.0, 5.0, 5.0)
+    s = sample_rois(jax.random.key(0), rois, rv, gt, gc, gv,
+                    batch_size=64, bbox_weights=w)
+    from mx_rcnn_tpu.geometry import decode_boxes
+
+    fg = np.asarray(s.fg_mask)
+    decoded = np.asarray(decode_boxes(s.bbox_targets, s.rois, weights=w))[fg]
+    # Each fg decode must land on one of the gt boxes.
+    gts = np.asarray(gt)[:2]
+    for box in decoded:
+        d = np.abs(gts - box).max(axis=1).min()
+        assert d < 1e-2
+
+
+def test_sample_rois_padding_zero_weight(rng):
+    # Only 3 valid proposals, no bg candidates in range -> padding appears.
+    gt = jnp.asarray([[10.0, 10.0, 50.0, 60.0]])
+    rois = jnp.asarray([[11.0, 11.0, 50.0, 59.0]] * 3, dtype=jnp.float32)
+    s = sample_rois(jax.random.key(0), rois, jnp.ones(3, bool), gt,
+                    jnp.asarray([2], jnp.int32), jnp.asarray([True]),
+                    batch_size=8, fg_fraction=0.5)
+    w = np.asarray(s.label_weights)
+    assert w.sum() <= 4  # 4 fg candidates max (3 rois + 1 gt), no bg
+    assert (w[int(w.sum()):] == 0).all()
