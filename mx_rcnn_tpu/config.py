@@ -1,0 +1,346 @@
+"""Immutable experiment configuration.
+
+Replaces the reference's mutable global config singleton
+(``rcnn/config.py``: one module-level easydict mutated by every CLI via
+``generate_config(network, dataset)``) with frozen dataclasses passed
+explicitly.  Nothing here is global; a config is constructed once (from a
+preset plus CLI overrides) and threaded through the program.
+
+The numeric defaults preserve the reference's semantics where parity
+matters (anchor geometry, NMS thresholds, fg/bg sampling quotas, bbox
+normalization stds) and upgrade to the FPN-era Detectron recipe where the
+BASELINE north star requires it (>=37 COCO mAP needs FPN + ROIAlign + the
+modern 1x schedule, not the 2017 C4 recipe).
+
+Presets mirror BASELINE.json's five configs — see :func:`get_config`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class AnchorConfig:
+    """Anchor geometry (reference: config.ANCHOR_SCALES / ANCHOR_RATIOS)."""
+
+    # Scales are in units of the stride at each level.  The reference's C4
+    # single-level setup uses base_size 16 with scales (8, 16, 32); FPN uses
+    # one scale (8) per level with strides (4..64) covering the same range.
+    scales: tuple[float, ...] = (8.0, 16.0, 32.0)
+    ratios: tuple[float, ...] = (0.5, 1.0, 2.0)
+
+    def num_anchors(self) -> int:
+        return len(self.scales) * len(self.ratios)
+
+
+@dataclass(frozen=True)
+class BackboneConfig:
+    name: str = "resnet50"  # resnet50 | resnet101 | vgg16
+    # Stages to freeze, counted like the reference's fixed_param_prefix
+    # (conv1 + res2 frozen for ResNet; conv1_/conv2_ for VGG).
+    freeze_stages: int = 2
+    # Frozen BatchNorm everywhere (reference: use_global_stats=True).
+    norm: str = "frozen_bn"  # frozen_bn | bn | gn
+    # Compute dtype for conv/matmul (params stay float32).
+    dtype: str = "bfloat16"
+
+
+@dataclass(frozen=True)
+class FPNConfig:
+    enabled: bool = True
+    channels: int = 256
+    min_level: int = 2
+    max_level: int = 6  # P6 by max-pool of P5 (RPN only)
+
+
+@dataclass(frozen=True)
+class RPNConfig:
+    """RPN head + proposal generation (reference: config.TRAIN/TEST RPN_*)."""
+
+    channels: int = 256  # hidden conv (VGG uses 512 in the reference)
+    # Anchor labeling (rcnn/io/rpn.py::assign_anchor semantics).
+    batch_size: int = 256
+    fg_fraction: float = 0.5
+    positive_iou: float = 0.7
+    negative_iou: float = 0.3
+    allowed_border: float = 0.0
+    # Proposal generation (rcnn/symbol/proposal.py semantics).
+    train_pre_nms_top_n: int = 2000
+    train_post_nms_top_n: int = 1000
+    test_pre_nms_top_n: int = 1000
+    test_post_nms_top_n: int = 1000
+    nms_threshold: float = 0.7
+    min_size: float = 0.0
+    loss_weight: float = 1.0
+
+
+@dataclass(frozen=True)
+class RCNNConfig:
+    """Second-stage sampling/head (reference: ProposalTarget + heads)."""
+
+    roi_batch_size: int = 512  # reference BATCH_ROIS (128 C4 / 512 FPN)
+    fg_fraction: float = 0.25
+    fg_iou: float = 0.5
+    bg_iou_hi: float = 0.5
+    bg_iou_lo: float = 0.0
+    # 1/std of the reference's TRAIN.BBOX_STDS (0.1, 0.1, 0.2, 0.2).
+    bbox_weights: tuple[float, float, float, float] = (10.0, 10.0, 5.0, 5.0)
+    pooled_size: int = 7
+    sampling_ratio: int = 2
+    hidden_dim: int = 1024  # 2-fc box head width (VGG fc6/fc7 use 4096)
+    # Class-agnostic box regression (False = per-class, reference default).
+    class_agnostic: bool = False
+    loss_weight: float = 1.0
+
+
+@dataclass(frozen=True)
+class MaskConfig:
+    enabled: bool = False
+    pooled_size: int = 14
+    channels: int = 256
+    num_convs: int = 4
+    resolution: int = 28
+    loss_weight: float = 1.0
+
+
+@dataclass(frozen=True)
+class TestConfig:
+    """Inference-time postprocessing (reference: config.TEST + pred_eval)."""
+
+    score_threshold: float = 0.05
+    nms_threshold: float = 0.5  # per-class NMS (reference uses 0.3 for VOC)
+    max_detections: int = 100
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    num_classes: int = 81  # includes background at index 0 (COCO: 80 + 1)
+    backbone: BackboneConfig = field(default_factory=BackboneConfig)
+    fpn: FPNConfig = field(default_factory=FPNConfig)
+    anchors: AnchorConfig = field(default_factory=AnchorConfig)
+    rpn: RPNConfig = field(default_factory=RPNConfig)
+    rcnn: RCNNConfig = field(default_factory=RCNNConfig)
+    mask: MaskConfig = field(default_factory=MaskConfig)
+    test: TestConfig = field(default_factory=TestConfig)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "coco"  # coco | voc | synthetic
+    root: str = "data"
+    train_split: str = "train2017"
+    val_split: str = "val2017"
+    # Static padded image size (H, W).  The reference resizes short side to
+    # SCALES[0]=600 capped at MAX_SIZE=1000 and re-binds executors per shape;
+    # we letterbox into one static canvas — the TPU-native equivalent.
+    image_size: tuple[int, int] = (1024, 1024)
+    short_side: int = 800
+    max_side: int = 1333
+    max_gt_boxes: int = 100
+    flip: bool = True
+    # Reference pixel means (BGR 123.68/116.78/103.94 order-swapped); we use
+    # RGB ImageNet mean/std.
+    pixel_mean: tuple[float, float, float] = (123.675, 116.28, 103.53)
+    pixel_std: tuple[float, float, float] = (58.395, 57.12, 57.375)
+    aspect_grouping: bool = True
+
+
+@dataclass(frozen=True)
+class ScheduleConfig:
+    """MultiFactor-style LR schedule (reference: lr_scheduler in drivers)."""
+
+    base_lr: float = 0.02  # for global batch 16; scaled linearly
+    warmup_steps: int = 500
+    warmup_factor: float = 1.0 / 3.0
+    # Steps at which lr is multiplied by `factor` (in units of train steps).
+    decay_steps: tuple[int, ...] = (60000, 80000)
+    factor: float = 0.1
+    total_steps: int = 90000
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    per_device_batch: int = 1  # reference: 1 image per GPU
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    grad_clip: float = 35.0  # reference: clip_gradient=5 per-example scale
+    schedule: ScheduleConfig = field(default_factory=ScheduleConfig)
+    checkpoint_every: int = 5000
+    log_every: int = 20
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class Config:
+    name: str = "faster_rcnn_r50_fpn_coco"
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    workdir: str = "runs"
+
+
+def _replace(cfg: Any, **kw: Any) -> Any:
+    return dataclasses.replace(cfg, **kw)
+
+
+def _c4_model(num_classes: int, backbone: str) -> ModelConfig:
+    """Classic C4 recipe: single-level stride-16 features, anchor scales
+    (8, 16, 32), ROIAlign on C4, conv5-as-head replaced by a 2-fc head."""
+    return ModelConfig(
+        num_classes=num_classes,
+        backbone=BackboneConfig(name=backbone),
+        fpn=FPNConfig(enabled=False),
+        anchors=AnchorConfig(scales=(8.0, 16.0, 32.0)),
+        rpn=RPNConfig(
+            channels=512,
+            train_pre_nms_top_n=6000,
+            train_post_nms_top_n=2000,
+            test_pre_nms_top_n=6000,
+            test_post_nms_top_n=300,
+        ),
+        rcnn=RCNNConfig(roi_batch_size=128),
+    )
+
+
+def _fpn_model(num_classes: int, backbone: str, mask: bool = False) -> ModelConfig:
+    return ModelConfig(
+        num_classes=num_classes,
+        backbone=BackboneConfig(name=backbone),
+        fpn=FPNConfig(enabled=True),
+        anchors=AnchorConfig(scales=(8.0,)),
+        rpn=RPNConfig(),
+        rcnn=RCNNConfig(),
+        mask=MaskConfig(enabled=mask),
+    )
+
+
+_PRESETS: dict[str, Any] = {}
+
+
+def _register(name: str, fn) -> None:
+    _PRESETS[name] = fn
+
+
+# The five BASELINE.json configs.
+_register(
+    "vgg16_voc07",
+    lambda: Config(
+        name="vgg16_voc07",
+        model=_replace(
+            _c4_model(21, "vgg16"),
+            rcnn=RCNNConfig(roi_batch_size=128, hidden_dim=4096),
+            test=TestConfig(nms_threshold=0.3, score_threshold=0.05),
+        ),
+        data=DataConfig(
+            dataset="voc",
+            train_split="2007_trainval",
+            val_split="2007_test",
+            image_size=(608, 1024),
+            short_side=600,
+            max_side=1000,
+            aspect_grouping=True,
+        ),
+        train=TrainConfig(
+            schedule=ScheduleConfig(
+                base_lr=0.001, decay_steps=(50000,), total_steps=70000,
+                warmup_steps=100,
+            ),
+        ),
+    ),
+)
+_register(
+    "r50_coco",
+    lambda: Config(
+        name="r50_coco",
+        model=_c4_model(81, "resnet50"),
+        data=DataConfig(dataset="coco"),
+        train=TrainConfig(),
+    ),
+)
+_register(
+    "r101_coco",
+    lambda: Config(
+        name="r101_coco",
+        model=_c4_model(81, "resnet101"),
+        data=DataConfig(dataset="coco"),
+        train=TrainConfig(),
+    ),
+)
+_register(
+    "r101_fpn_coco",
+    lambda: Config(
+        name="r101_fpn_coco",
+        model=_fpn_model(81, "resnet101"),
+        data=DataConfig(dataset="coco"),
+        train=TrainConfig(),
+    ),
+)
+_register(
+    "mask_r50_fpn_coco",
+    lambda: Config(
+        name="mask_r50_fpn_coco",
+        model=_fpn_model(81, "resnet50", mask=True),
+        data=DataConfig(dataset="coco"),
+        train=TrainConfig(),
+    ),
+)
+# Default/flagship and test presets.
+_register(
+    "r50_fpn_coco",
+    lambda: Config(
+        name="r50_fpn_coco",
+        model=_fpn_model(81, "resnet50"),
+        data=DataConfig(dataset="coco"),
+        train=TrainConfig(),
+    ),
+)
+_register(
+    "tiny_synthetic",
+    lambda: Config(
+        name="tiny_synthetic",
+        model=_replace(
+            _fpn_model(5, "resnet50"),
+            backbone=BackboneConfig(name="resnet50", freeze_stages=0, dtype="float32"),
+            rpn=RPNConfig(
+                batch_size=64,
+                train_pre_nms_top_n=200,
+                train_post_nms_top_n=64,
+                test_pre_nms_top_n=200,
+                test_post_nms_top_n=64,
+            ),
+            rcnn=RCNNConfig(roi_batch_size=32, hidden_dim=128),
+        ),
+        data=DataConfig(
+            dataset="synthetic",
+            image_size=(128, 128),
+            short_side=128,
+            max_side=128,
+            max_gt_boxes=8,
+        ),
+        train=TrainConfig(
+            schedule=ScheduleConfig(
+                base_lr=0.01, warmup_steps=10, decay_steps=(400,), total_steps=500
+            ),
+            checkpoint_every=250,
+        ),
+    ),
+)
+
+
+def available_configs() -> list[str]:
+    return sorted(_PRESETS)
+
+
+def get_config(name: str, **overrides: Any) -> Config:
+    """Build a preset config; kwargs replace top-level Config fields.
+
+    Replaces the reference's ``generate_config(network, dataset)`` mutator:
+    instead of mutating a global, returns a frozen Config.
+    """
+    if name not in _PRESETS:
+        raise KeyError(f"unknown config {name!r}; available: {available_configs()}")
+    cfg = _PRESETS[name]()
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

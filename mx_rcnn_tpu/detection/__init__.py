@@ -1,0 +1,17 @@
+from mx_rcnn_tpu.detection.detector import TwoStageDetector
+from mx_rcnn_tpu.detection.graph import (
+    Batch,
+    Detections,
+    forward_train,
+    forward_inference,
+    init_detector,
+)
+
+__all__ = [
+    "TwoStageDetector",
+    "Batch",
+    "Detections",
+    "forward_train",
+    "forward_inference",
+    "init_detector",
+]

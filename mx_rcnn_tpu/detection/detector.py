@@ -1,0 +1,109 @@
+"""The assembled two-stage detector as one flax module.
+
+Replaces the reference's symbol-graph builders (``rcnn/symbol/symbol_vgg.py``
+``get_vgg_train/test`` and ``symbol_resnet.py`` equivalents).  Where the
+reference builds four separate static graphs (train / test / rpn-only /
+rcnn-only) and stitches host-side custom ops between them, this module only
+owns the *parameterized* pieces (backbone, neck, heads) as callable methods;
+the parameter-free detection logic (anchors, proposals, sampling, ROIAlign,
+losses) lives in :mod:`mx_rcnn_tpu.detection.graph` as pure functions, so
+train/test/rpn-phase graphs are compositions, not copies.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mx_rcnn_tpu.config import ModelConfig
+from mx_rcnn_tpu.models.build import _DTYPES, build_backbone
+from mx_rcnn_tpu.models.fpn import FPN
+from mx_rcnn_tpu.models.heads import BoxHead, MaskHead, RPNHead
+
+
+class TwoStageDetector(nn.Module):
+    cfg: ModelConfig
+
+    @property
+    def feature_levels(self) -> tuple[int, ...]:
+        """Levels the RPN sees (stride of level l is 2**l)."""
+        if self.cfg.fpn.enabled:
+            return tuple(range(self.cfg.fpn.min_level, self.cfg.fpn.max_level + 1))
+        return (4,)  # C4 recipe: single stride-16 feature
+
+    @property
+    def roi_levels(self) -> tuple[int, ...]:
+        """Levels ROIAlign reads (FPN excludes the RPN-only P6)."""
+        if self.cfg.fpn.enabled:
+            return tuple(range(self.cfg.fpn.min_level, min(self.cfg.fpn.max_level, 5) + 1))
+        return (4,)
+
+    def setup(self):
+        cfg = self.cfg
+        dtype = _DTYPES[cfg.backbone.dtype]
+        backbone_levels = (2, 3, 4, 5) if cfg.fpn.enabled else (4,)
+        self.backbone = build_backbone(cfg.backbone, out_levels=backbone_levels)
+        if cfg.fpn.enabled:
+            self.fpn = FPN(
+                channels=cfg.fpn.channels,
+                min_level=cfg.fpn.min_level,
+                max_level=cfg.fpn.max_level,
+                dtype=dtype,
+                name="fpn",
+            )
+        self.rpn_head = RPNHead(
+            num_anchors=cfg.anchors.num_anchors(),
+            channels=cfg.rpn.channels,
+            dtype=dtype,
+            name="rpn",
+        )
+        self.box_head = BoxHead(
+            num_classes=cfg.num_classes,
+            hidden_dim=cfg.rcnn.hidden_dim,
+            class_agnostic=cfg.rcnn.class_agnostic,
+            dtype=dtype,
+            name="box_head",
+        )
+        if cfg.mask.enabled:
+            self.mask_head = MaskHead(
+                num_classes=cfg.num_classes,
+                channels=cfg.mask.channels,
+                num_convs=cfg.mask.num_convs,
+                dtype=dtype,
+                name="mask_head",
+            )
+
+    def features(self, images: jnp.ndarray) -> dict[int, jnp.ndarray]:
+        """images (B, H, W, 3) normalized -> {level: (B, H_l, W_l, C)}."""
+        feats = self.backbone(images)
+        if self.cfg.fpn.enabled:
+            feats = self.fpn(feats)
+        return feats
+
+    def rpn(self, feats: dict[int, jnp.ndarray]):
+        """Per-level RPN outputs: {level: (logits (B, A_l), deltas (B, A_l, 4))}.
+
+        One weight-shared head over all levels (FPN paper); for C4 there is
+        only one level.
+        """
+        return {lvl: self.rpn_head(feats[lvl]) for lvl in sorted(feats)}
+
+    def box(self, pooled: jnp.ndarray):
+        """pooled (R, S, S, C) -> (cls_logits (R, C), deltas (R, C or 1, 4))."""
+        return self.box_head(pooled)
+
+    def mask(self, pooled: jnp.ndarray) -> jnp.ndarray:
+        return self.mask_head(pooled)
+
+    def __call__(self, images: jnp.ndarray):
+        """Init-only pass touching every parameter."""
+        feats = self.features(images)
+        rpn_out = self.rpn(feats)
+        c = feats[self.roi_levels[0]].shape[-1]
+        s = self.cfg.rcnn.pooled_size
+        dummy = jnp.zeros((1, s, s, c), feats[self.roi_levels[0]].dtype)
+        box_out = self.box(dummy)
+        if self.cfg.mask.enabled:
+            sm = self.cfg.mask.pooled_size
+            self.mask(jnp.zeros((1, sm, sm, c), dummy.dtype))
+        return rpn_out, box_out

@@ -1,0 +1,402 @@
+"""Train / inference computations for the two-stage detector.
+
+This file is the TPU-native replacement for the reference's whole execution
+sandwich (SURVEY.md section 4.1): the symbolic train graph with two
+host-round-trip custom ops in its middle (``rcnn/symbol/proposal.py``,
+``rcnn/symbol/proposal_target.py``), the host-side anchor labeling inside
+the loader (``rcnn/io/rpn.py::assign_anchor``), and the test-time
+``rcnn/core/tester.py::im_detect`` + per-class NMS loop.  Everything here is
+a pure function of (variables, batch, rng) with static shapes — one jitted
+region per train/eval step, zero host interaction.
+
+Shape conventions:
+  B = batch, G = max gt boxes, A = total anchors over levels,
+  R = proposals per image, S = pooled size, C = num classes (incl. bg 0).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mx_rcnn_tpu.config import ModelConfig
+from mx_rcnn_tpu.detection.detector import TwoStageDetector
+from mx_rcnn_tpu.geometry import (
+    clip_boxes,
+    decode_boxes,
+    generate_base_anchors,
+    masked_softmax_cross_entropy,
+    shifted_anchors,
+    weighted_smooth_l1,
+)
+from mx_rcnn_tpu.ops import assign_anchors, generate_proposals, roi_align, sample_rois
+from mx_rcnn_tpu.ops.nms import nms_indices
+from mx_rcnn_tpu.ops.proposals import Proposals, generate_fpn_proposals
+from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
+
+
+class Batch(NamedTuple):
+    """One statically-shaped training/eval batch (data/ produces these)."""
+
+    images: jnp.ndarray       # (B, H, W, 3) float32, normalized
+    image_hw: jnp.ndarray     # (B, 2) float32 true (unpadded) height, width
+    gt_boxes: jnp.ndarray     # (B, G, 4)
+    gt_classes: jnp.ndarray   # (B, G) int32, 0 = background/padding
+    gt_valid: jnp.ndarray     # (B, G) bool
+    gt_masks: Optional[jnp.ndarray] = None  # (B, G, Hm, Wm) float32 in [0,1]
+
+
+class Detections(NamedTuple):
+    boxes: jnp.ndarray    # (B, D, 4) in input-image coordinates
+    scores: jnp.ndarray   # (B, D)
+    classes: jnp.ndarray  # (B, D) int32, 1-based foreground ids
+    valid: jnp.ndarray    # (B, D) bool
+    masks: Optional[jnp.ndarray] = None  # (B, D, M, M) probabilities
+
+
+# ---------------------------------------------------------------------------
+# Anchors
+
+
+def level_anchors(
+    cfg: ModelConfig, feats: dict[int, jnp.ndarray]
+) -> dict[int, jnp.ndarray]:
+    """Static per-level anchor grids for the given feature shapes.
+
+    Anchor base size is the level stride (FPN: one octave per level); the C4
+    recipe's single level 4 with scales (8, 16, 32) reproduces the
+    reference's 128/256/512-pixel anchors exactly.
+    """
+    out = {}
+    for lvl in sorted(feats):
+        stride = 2**lvl
+        base = generate_base_anchors(
+            base_size=stride, ratios=cfg.anchors.ratios, scales=cfg.anchors.scales
+        )
+        _, h, w, _ = feats[lvl].shape
+        out[lvl] = shifted_anchors(jnp.asarray(base), stride, h, w)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Losses
+
+
+def _rpn_losses(rpn_logits, rpn_deltas, targets):
+    """RPN objectness + box losses, per reference normalization.
+
+    rpn_logits (B, A), rpn_deltas (B, A, 4); targets from assign_anchors
+    vmapped over B.  Objectness is sigmoid BCE over sampled anchors
+    normalized by valid count (the reference's 2-way softmax with
+    ignore_label=-1 and normalization='valid' — same quantity); box loss is
+    smooth_l1(sigma=3) on fg anchors normalized by the same count
+    (reference grad_scale = 1/RPN_BATCH_SIZE per image).
+    """
+    labels = targets.labels            # (B, A) 1/0/-1
+    valid = targets.valid_mask         # (B, A)
+    fg = targets.fg_mask               # (B, A)
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+
+    logp = jax.nn.log_sigmoid(rpn_logits)
+    log1mp = jax.nn.log_sigmoid(-rpn_logits)
+    is_fg = (labels == 1).astype(rpn_logits.dtype)
+    bce = -(is_fg * logp + (1.0 - is_fg) * log1mp)
+    cls_loss = jnp.sum(bce * valid) / n_valid
+
+    box_loss = weighted_smooth_l1(
+        rpn_deltas,
+        targets.bbox_targets,
+        inside_weight=fg[..., None].astype(rpn_deltas.dtype),
+        sigma=3.0,
+        normalizer=n_valid,
+    )
+
+    pred_fg = rpn_logits > 0.0
+    acc = jnp.sum((pred_fg == (labels == 1)) * valid) / n_valid
+    return cls_loss, box_loss, acc
+
+
+def _rcnn_losses(cls_logits, box_deltas, samples, class_agnostic: bool):
+    """R-CNN classification + per-class box regression losses.
+
+    cls_logits (N, C), box_deltas (N, C or 1, 4) over N = B*roi_batch
+    flattened samples.  Matches the reference's SoftmaxOutput
+    (normalization='valid') + smooth_l1(sigma=1) scaled 1/BATCH_ROIS.
+    """
+    labels = samples.labels.reshape(-1)            # (N,)
+    weights = samples.label_weights.reshape(-1)    # (N,)
+    fg = samples.fg_mask.reshape(-1)               # (N,)
+    targets = samples.bbox_targets.reshape(-1, 4)  # (N, 4)
+    n_valid = jnp.maximum(jnp.sum(weights), 1.0)
+
+    cls_loss = masked_softmax_cross_entropy(cls_logits, labels, weights)
+
+    if class_agnostic:
+        sel = box_deltas[:, 0, :]
+    else:
+        idx = jnp.clip(labels, 0, box_deltas.shape[1] - 1)
+        sel = jnp.take_along_axis(box_deltas, idx[:, None, None].repeat(4, -1), axis=1)[:, 0, :]
+    box_loss = weighted_smooth_l1(
+        sel,
+        targets,
+        inside_weight=fg[:, None].astype(sel.dtype),
+        sigma=1.0,
+        normalizer=n_valid,
+    )
+
+    pred = jnp.argmax(cls_logits, axis=-1)
+    acc = jnp.sum((pred == labels) * weights) / n_valid
+    return cls_loss, box_loss, acc
+
+
+# ---------------------------------------------------------------------------
+# Proposal plumbing (per-image, vmapped)
+
+
+def _propose_one(cfg: ModelConfig, train: bool):
+    """Builds the per-image proposal fn over concatenated level outputs."""
+    rpn_cfg = cfg.rpn
+    pre = rpn_cfg.train_pre_nms_top_n if train else rpn_cfg.test_pre_nms_top_n
+    post = rpn_cfg.train_post_nms_top_n if train else rpn_cfg.test_post_nms_top_n
+
+    def single(level_scores, level_deltas, level_anchor, hw) -> Proposals:
+        if len(level_scores) == 1:
+            (s,), (d,), (a,) = (
+                list(level_scores.values()),
+                list(level_deltas.values()),
+                list(level_anchor.values()),
+            )
+            return generate_proposals(
+                s, d, a, hw[0], hw[1],
+                pre_nms_top_n=pre, post_nms_top_n=post,
+                nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
+            )
+        return generate_fpn_proposals(
+            level_scores, level_deltas, level_anchor, hw[0], hw[1],
+            pre_nms_top_n=pre, post_nms_top_n=post,
+            nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
+        )
+
+    return single
+
+
+def _slice_levels(levels, anchors, score_row, delta_row):
+    """Split concatenated per-anchor rows back into per-level dicts, paired
+    with each level's static anchor grid.  Shared by train and inference."""
+    off = 0
+    s_lvls, d_lvls, a_lvls = {}, {}, {}
+    for l in levels:
+        n = anchors[l].shape[0]
+        s_lvls[l] = score_row[off:off + n]
+        d_lvls[l] = delta_row[off:off + n]
+        a_lvls[l] = anchors[l]
+        off += n
+    return s_lvls, d_lvls, a_lvls
+
+
+def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
+    """ROIAlign vmapped over the batch. rois: (B, R, 4) -> (B, R, S, S, C)."""
+    levels = sorted(feats)
+    if len(levels) > 1:
+        roi_levels = {l: f for l, f in feats.items() if l in roi_level_set}
+        return jax.vmap(
+            lambda fs, r: multilevel_roi_align(
+                fs, r, output_size=pooled_size, sampling_ratio=cfg.rcnn.sampling_ratio
+            )
+        )(roi_levels, rois)
+    lvl = levels[0]
+    return jax.vmap(
+        lambda f, r: roi_align(
+            f, r, pooled_size, 1.0 / (2**lvl), cfg.rcnn.sampling_ratio
+        )
+    )(feats[lvl], rois)
+
+
+# ---------------------------------------------------------------------------
+# Public graphs
+
+
+def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: int = 1):
+    """Initialize all variables (params + frozen-BN constants)."""
+    h, w = image_size
+    dummy = jnp.zeros((batch, h, w, 3), jnp.float32)
+    return model.init(rng, dummy)
+
+
+def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Batch):
+    """One full training forward pass -> (total_loss, metrics dict).
+
+    Differentiable w.r.t. ``variables['params']``.  Equivalent of the
+    reference's train symbol forward (SURVEY.md section 4.1 hot loop) with
+    both CustomOp host syncs replaced by in-graph ops.
+    """
+    cfg = model.cfg
+    feats = model.apply(variables, batch.images, method="features")
+    rpn_out = model.apply(variables, feats, method="rpn")
+
+    anchors = level_anchors(cfg, feats)
+    levels = sorted(rpn_out)
+    logits_cat = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)  # (B, A)
+    deltas_cat = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)  # (B, A, 4)
+    anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)    # (A, 4)
+
+    b = batch.images.shape[0]
+    rng_assign, rng_sample = jax.random.split(rng)
+
+    targets = jax.vmap(
+        lambda k, gt, gv, hw: assign_anchors_cfg(
+            cfg, k, anchors_cat, gt, gv, hw[0], hw[1]
+        )
+    )(jax.random.split(rng_assign, b), batch.gt_boxes, batch.gt_valid, batch.image_hw)
+
+    rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
+
+    # Proposals are detached: the reference never backprops through the
+    # Proposal op either (CustomOp forward-only); gradients reach the RPN
+    # exclusively through its losses.
+    scores = jax.nn.sigmoid(lax.stop_gradient(logits_cat))
+    deltas_sg = lax.stop_gradient(deltas_cat)
+    propose = _propose_one(cfg, train=True)
+    props = jax.vmap(
+        lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
+    )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
+
+    samples = jax.vmap(
+        lambda k, rois, rv, gt, gc, gv: sample_rois(
+            k, rois, rv, gt, gc, gv,
+            batch_size=cfg.rcnn.roi_batch_size,
+            fg_fraction=cfg.rcnn.fg_fraction,
+            fg_iou=cfg.rcnn.fg_iou,
+            bg_iou_hi=cfg.rcnn.bg_iou_hi,
+            bg_iou_lo=cfg.rcnn.bg_iou_lo,
+            bbox_weights=cfg.rcnn.bbox_weights,
+        )
+    )(
+        jax.random.split(rng_sample, b),
+        props.rois,
+        props.valid,
+        batch.gt_boxes,
+        batch.gt_classes.astype(jnp.int32),
+        batch.gt_valid,
+    )
+
+    pooled = _pool_rois(cfg, feats, samples.rois, cfg.rcnn.pooled_size, model.roi_levels)
+    s = cfg.rcnn.pooled_size
+    pooled_flat = pooled.reshape(-1, s, s, pooled.shape[-1])
+    cls_logits, box_deltas = model.apply(variables, pooled_flat, method="box")
+
+    rcnn_cls, rcnn_box, rcnn_acc = _rcnn_losses(
+        cls_logits, box_deltas, samples, cfg.rcnn.class_agnostic
+    )
+
+    total = (
+        cfg.rpn.loss_weight * (rpn_cls + rpn_box)
+        + cfg.rcnn.loss_weight * (rcnn_cls + rcnn_box)
+    )
+    metrics = {
+        # Names mirror the reference's six EvalMetrics (rcnn/core/metric.py).
+        "RPNAcc": rpn_acc,
+        "RPNLogLoss": rpn_cls,
+        "RPNL1Loss": rpn_box,
+        "RCNNAcc": rcnn_acc,
+        "RCNNLogLoss": rcnn_cls,
+        "RCNNL1Loss": rcnn_box,
+        "loss": total,
+    }
+    return total, metrics
+
+
+def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w):
+    return assign_anchors(
+        key, anchors, gt, gv, h, w,
+        batch_size=cfg.rpn.batch_size,
+        fg_fraction=cfg.rpn.fg_fraction,
+        positive_iou=cfg.rpn.positive_iou,
+        negative_iou=cfg.rpn.negative_iou,
+        allowed_border=cfg.rpn.allowed_border,
+    )
+
+
+def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detections:
+    """Full inference: proposals -> box head -> per-class NMS -> top-D.
+
+    Replaces ``rcnn/core/tester.py::im_detect`` + the per-class python NMS
+    loop in ``pred_eval`` with one jitted region; detections come back
+    padded to ``cfg.test.max_detections`` with a validity mask.
+    """
+    cfg = model.cfg
+    feats = model.apply(variables, batch.images, method="features")
+    rpn_out = model.apply(variables, feats, method="rpn")
+    anchors = level_anchors(cfg, feats)
+    levels = sorted(rpn_out)
+
+    logits_cat = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)
+    deltas_cat = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)
+    scores = jax.nn.sigmoid(logits_cat)
+    propose = _propose_one(cfg, train=False)
+    props = jax.vmap(
+        lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
+    )(scores, deltas_cat, batch.image_hw)
+
+    pooled = _pool_rois(cfg, feats, props.rois, cfg.rcnn.pooled_size, model.roi_levels)
+    s = cfg.rcnn.pooled_size
+    pooled_flat = pooled.reshape(-1, s, s, pooled.shape[-1])
+    cls_logits, box_deltas = model.apply(variables, pooled_flat, method="box")
+
+    b, r = props.rois.shape[:2]
+    num_classes = cfg.num_classes
+    cls_prob = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, num_classes)
+    box_deltas = box_deltas.reshape(b, r, -1, 4)
+
+    post = jax.vmap(
+        lambda rois, rv, probs, deltas, hw: _postprocess_one(
+            cfg, rois, rv, probs, deltas, hw
+        )
+    )(props.rois, props.valid, cls_prob, box_deltas, batch.image_hw)
+    return Detections(*post)
+
+
+def _postprocess_one(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw):
+    """Per-image postprocess: decode per class, threshold, per-class NMS,
+    global top-D.  All static shapes: (R rois) x (C-1 fg classes)."""
+    num_classes = cfg.num_classes
+    r = rois.shape[0]
+    d_out = cfg.test.max_detections
+    per_class_k = min(r, max(2 * d_out, 100))
+
+    def one_class(c):
+        delta_c = deltas[:, 0, :] if cfg.rcnn.class_agnostic else deltas[:, c, :]
+        boxes = decode_boxes(delta_c, rois, weights=cfg.rcnn.bbox_weights)
+        boxes = clip_boxes(boxes, hw[0], hw[1])
+        sc = jnp.where(
+            roi_valid & (probs[:, c] >= cfg.test.score_threshold),
+            probs[:, c],
+            -jnp.inf,
+        )
+        top_s, top_i = lax.top_k(sc, per_class_k)
+        top_b = jnp.take(boxes, top_i, axis=0)
+        keep_i, keep_v = nms_indices(
+            top_b, top_s, cfg.test.nms_threshold, per_class_k
+        )
+        out_b = jnp.take(top_b, keep_i, axis=0)
+        out_s = jnp.where(keep_v, jnp.take(top_s, keep_i), -jnp.inf)
+        return out_b, out_s
+
+    # vmap over foreground classes (1..C-1).
+    cls_ids = jnp.arange(1, num_classes)
+    all_b, all_s = jax.vmap(one_class)(cls_ids)        # (C-1, K, 4), (C-1, K)
+    flat_b = all_b.reshape(-1, 4)
+    flat_s = all_s.reshape(-1)
+    flat_c = jnp.repeat(cls_ids, per_class_k)
+
+    top_s, top_i = lax.top_k(flat_s, d_out)
+    valid = jnp.isfinite(top_s)
+    return (
+        jnp.take(flat_b, top_i, axis=0) * valid[:, None],
+        jnp.where(valid, top_s, 0.0),
+        jnp.where(valid, jnp.take(flat_c, top_i), 0).astype(jnp.int32),
+        valid,
+    )

@@ -1,0 +1,7 @@
+from mx_rcnn_tpu.models.resnet import ResNet
+from mx_rcnn_tpu.models.vgg import VGG16
+from mx_rcnn_tpu.models.fpn import FPN
+from mx_rcnn_tpu.models.heads import RPNHead, BoxHead, MaskHead
+from mx_rcnn_tpu.models.build import build_backbone
+
+__all__ = ["ResNet", "VGG16", "FPN", "RPNHead", "BoxHead", "MaskHead", "build_backbone"]

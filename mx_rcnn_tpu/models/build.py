@@ -1,0 +1,22 @@
+"""Backbone factory: BackboneConfig -> flax module + metadata."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mx_rcnn_tpu.config import BackboneConfig
+from mx_rcnn_tpu.models.resnet import ResNet, STAGE_BLOCKS
+from mx_rcnn_tpu.models.vgg import VGG16
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+def build_backbone(cfg: BackboneConfig, out_levels: tuple[int, ...] = (2, 3, 4, 5)) -> nn.Module:
+    dtype = _DTYPES[cfg.dtype]
+    if cfg.name in STAGE_BLOCKS:
+        return ResNet(blocks=STAGE_BLOCKS[cfg.name], norm=cfg.norm, dtype=dtype,
+                      out_levels=out_levels, name="backbone")
+    if cfg.name == "vgg16":
+        return VGG16(dtype=dtype, name="backbone")
+    raise ValueError(f"unknown backbone {cfg.name!r}")

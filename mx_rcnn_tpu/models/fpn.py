@@ -1,0 +1,45 @@
+"""Feature Pyramid Network neck (Lin et al. 2017).
+
+Not present in the reference (its R-CNN head reads a single C4 feature) but
+required by the BASELINE north star (>=37 COCO mAP) and anticipated by
+BASELINE config #4.  Standard top-down pathway: 1x1 lateral projections,
+nearest-neighbor upsample + add, 3x3 output convs, plus P6 via stride-2
+max-pool of P5 for RPN anchors at stride 64.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class FPN(nn.Module):
+    channels: int = 256
+    min_level: int = 2
+    max_level: int = 6
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: dict[int, jnp.ndarray]) -> dict[int, jnp.ndarray]:
+        backbone_levels = sorted(k for k in feats if self.min_level <= k)
+        laterals = {
+            lvl: nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                         name=f"lateral{lvl}")(feats[lvl])
+            for lvl in backbone_levels
+        }
+        top = max(backbone_levels)
+        merged = {top: laterals[top]}
+        for lvl in sorted(backbone_levels[:-1], reverse=True):
+            up = merged[lvl + 1]
+            b, h, w, c = up.shape
+            up = jax.image.resize(up, (b, h * 2, w * 2, c), method="nearest")
+            merged[lvl] = laterals[lvl] + up
+        out = {
+            lvl: nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                         dtype=self.dtype, name=f"output{lvl}")(merged[lvl])
+            for lvl in backbone_levels
+        }
+        for lvl in range(top + 1, self.max_level + 1):
+            out[lvl] = nn.max_pool(out[lvl - 1], (1, 1), strides=(2, 2))
+        return out

@@ -1,0 +1,100 @@
+"""Detection heads: RPN, box (R-CNN), mask.
+
+Rebuilds the head graphs of ``rcnn/symbol/symbol_vgg.py`` /
+``symbol_resnet.py``:
+
+- RPN head: 3x3 conv + ReLU, then 1x1 objectness (k logits, sigmoid — the
+  reference uses a 2k-channel softmax; sigmoid is the numerically identical
+  modern form) and 1x1 regression (4k).  One head shared across FPN levels
+  (weight sharing per the FPN paper); the C4 recipe calls it on one level.
+- Box head: flattened ROI features -> fc -> fc -> {cls_score (C),
+  bbox_pred (4C or 4)} — the reference's fc6/fc7 (VGG) generalized.
+- Mask head: 4x conv + deconv + 1x1 (Mask R-CNN), for BASELINE config #5.
+
+Initialization follows the reference's train drivers: Normal(0.01) for cls
+weights, Normal(0.001) for bbox_pred (it uses 0.01/0.001 via
+``mx.init.Normal``), zeros for biases.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+_init01 = nn.initializers.normal(0.01)
+_init001 = nn.initializers.normal(0.001)
+
+
+class RPNHead(nn.Module):
+    num_anchors: int
+    channels: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """x: (B, H, W, C) -> logits (B, H*W*A), deltas (B, H*W*A, 4).
+
+        Flattening order is (H, W, A) row-major — anchor generation
+        (geometry/anchors.py::shifted_anchors) must match.
+        """
+        y = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                    dtype=self.dtype, kernel_init=_init01, name="conv")(x)
+        y = nn.relu(y)
+        logits = nn.Conv(self.num_anchors, (1, 1), dtype=self.dtype,
+                         kernel_init=_init01, name="objectness")(y)
+        deltas = nn.Conv(self.num_anchors * 4, (1, 1), dtype=self.dtype,
+                         kernel_init=_init001, name="deltas")(y)
+        b = x.shape[0]
+        return (
+            logits.reshape(b, -1).astype(jnp.float32),
+            deltas.reshape(b, -1, 4).astype(jnp.float32),
+        )
+
+
+class BoxHead(nn.Module):
+    num_classes: int  # includes background class 0
+    hidden_dim: int = 1024
+    class_agnostic: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, rois: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """rois: (R, S, S, C) pooled features -> (R, num_classes) logits,
+        (R, num_classes (or 1), 4) box deltas."""
+        r = rois.shape[0]
+        x = rois.reshape(r, -1).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc6")(x))
+        x = nn.relu(nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc7")(x))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          kernel_init=_init01, name="cls_score")(x)
+        n_reg = 1 if self.class_agnostic else self.num_classes
+        deltas = nn.Dense(n_reg * 4, dtype=self.dtype,
+                          kernel_init=_init001, name="bbox_pred")(x)
+        return (
+            logits.astype(jnp.float32),
+            deltas.reshape(r, n_reg, 4).astype(jnp.float32),
+        )
+
+
+class MaskHead(nn.Module):
+    num_classes: int
+    channels: int = 256
+    num_convs: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, rois: jnp.ndarray) -> jnp.ndarray:
+        """rois: (R, S, S, C) -> (R, 2S, 2S, num_classes) mask logits."""
+        x = rois.astype(self.dtype)
+        for i in range(self.num_convs):
+            x = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                        dtype=self.dtype, kernel_init=_init01,
+                        name=f"conv{i + 1}")(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(self.channels, (2, 2), strides=(2, 2),
+                             dtype=self.dtype, kernel_init=_init01,
+                             name="deconv")(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
+                    kernel_init=_init01, name="mask_logits")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,46 @@
+"""Normalization layers.
+
+The reference runs every BatchNorm with ``use_global_stats=True`` and frozen
+gamma/beta (``rcnn/symbol/symbol_resnet.py``: BN params in fixed_param /
+aux states never updated) — detection fine-tuning with per-GPU batch 1 makes
+live BN statistics useless.  :class:`FrozenBatchNorm` reproduces that as a
+pure affine transform whose four tensors live in a dedicated, non-trainable
+``constants`` collection, so the optimizer never sees them and pretrained
+ImageNet statistics pass through untouched.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class FrozenBatchNorm(nn.Module):
+    """y = (x - mean) / sqrt(var + eps) * scale + bias, all four frozen."""
+
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.variable("constants", "scale", nn.initializers.ones, None, (c,))
+        bias = self.variable("constants", "bias", nn.initializers.zeros, None, (c,))
+        mean = self.variable("constants", "mean", nn.initializers.zeros, None, (c,))
+        var = self.variable("constants", "var", nn.initializers.ones, None, (c,))
+        # Fold into one multiply-add (XLA fuses this into the preceding conv).
+        mul = (scale.value / jnp.sqrt(var.value + self.eps)).astype(self.dtype)
+        add = (bias.value - mean.value * scale.value / jnp.sqrt(var.value + self.eps)).astype(self.dtype)
+        return x * mul + add
+
+
+def make_norm(kind: str, dtype: jnp.dtype, name: str | None = None) -> nn.Module:
+    if kind == "frozen_bn":
+        return FrozenBatchNorm(dtype=dtype, name=name)
+    if kind == "gn":
+        return nn.GroupNorm(num_groups=32, dtype=dtype, name=name)
+    if kind == "bn":
+        # Live BN is only sound with large per-device batches; exposed for
+        # from-scratch recipes (SURVEY.md section 8 hard part #3).
+        return nn.BatchNorm(use_running_average=True, dtype=dtype, name=name)
+    raise ValueError(f"unknown norm {kind!r}")

@@ -1,0 +1,94 @@
+"""ResNet backbones (50/101), NHWC, detection-flavored.
+
+TPU-native rebuild of ``rcnn/symbol/symbol_resnet.py``'s residual-unit
+builder (``residual_unit`` / ``get_resnet_conv``): same topology
+(bottleneck-v1, stride-2 downsampling in the 3x3 conv per the torchvision
+convention, frozen BN), expressed as flax modules emitting an NHWC feature
+pyramid ``{2: C2, 3: C3, 4: C4, 5: C5}`` instead of a single symbolic C4
+blob — both the C4 single-level recipe and FPN consume it.
+
+TPU notes: convolutions run in ``dtype`` (bfloat16 by default) with float32
+params; XLA tiles NHWC convs onto the MXU directly.  Stage freezing is done
+by the optimizer mask (train/optim.py), not in-graph, so one compiled graph
+serves all freeze policies.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mx_rcnn_tpu.models.norm import make_norm
+
+STAGE_BLOCKS = {
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3(stride) -> 1x1(x4) with projection shortcut on shape change."""
+
+    channels: int  # bottleneck width; output is channels * 4
+    stride: int = 1
+    norm: str = "frozen_bn"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        out_ch = self.channels * 4
+        conv = lambda c, k, s, name: nn.Conv(  # noqa: E731
+            c, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+            use_bias=False, dtype=self.dtype, name=name,
+        )
+        residual = x
+        y = conv(self.channels, 1, 1, "conv1")(x)
+        y = make_norm(self.norm, self.dtype, "bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.channels, 3, self.stride, "conv2")(y)
+        y = make_norm(self.norm, self.dtype, "bn2")(y)
+        y = nn.relu(y)
+        y = conv(out_ch, 1, 1, "conv3")(y)
+        y = make_norm(self.norm, self.dtype, "bn3")(y)
+        if residual.shape[-1] != out_ch or self.stride != 1:
+            residual = conv(out_ch, 1, self.stride, "downsample_conv")(x)
+            residual = make_norm(self.norm, self.dtype, "downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Returns {2: C2, 3: C3, 4: C4, 5: C5} (strides 4/8/16/32), NHWC."""
+
+    blocks: Sequence[int] = STAGE_BLOCKS["resnet50"]
+    norm: str = "frozen_bn"
+    dtype: jnp.dtype = jnp.bfloat16
+    out_levels: tuple[int, ...] = (2, 3, 4, 5)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> dict[int, jnp.ndarray]:
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        x = make_norm(self.norm, self.dtype, "bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        feats: dict[int, jnp.ndarray] = {}
+        widths = (64, 128, 256, 512)
+        for i, (n_blocks, width) in enumerate(zip(self.blocks, widths)):
+            stride = 1 if i == 0 else 2
+            for b in range(n_blocks):
+                x = Bottleneck(
+                    channels=width,
+                    stride=stride if b == 0 else 1,
+                    norm=self.norm,
+                    dtype=self.dtype,
+                    name=f"layer{i + 1}_block{b}",
+                )(x)
+            level = i + 2
+            if level in self.out_levels:
+                feats[level] = x
+        return feats

@@ -1,0 +1,34 @@
+"""VGG-16 trunk (conv1_1..conv5_3), NHWC.
+
+Rebuild of ``rcnn/symbol/symbol_vgg.py::get_vgg_conv``: 13 conv layers in 5
+groups with 2x2 max-pools after groups 1-4 (the reference drops the pool5,
+leaving stride 16 for the RPN/ROI features).  Emitted as a one-entry pyramid
+dict for interface parity with ResNet, keyed by log2(stride): conv5_3 sits
+after 4 pools (stride 16), so it is level 4 — the same key as ResNet's C4 —
+and the C4-recipe code path is backbone-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+VGG16_GROUPS = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+class VGG16(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> dict[int, jnp.ndarray]:
+        x = x.astype(self.dtype)
+        feats: dict[int, jnp.ndarray] = {}
+        for g, (ch, n_convs) in enumerate(VGG16_GROUPS):
+            for c in range(n_convs):
+                x = nn.Conv(ch, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype,
+                            name=f"conv{g + 1}_{c + 1}")(x)
+                x = nn.relu(x)
+            if g < 4:  # no pool5 (reference keeps stride 16)
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            feats[g + 1] = x
+        return {4: feats[5]}  # stride 16 == 2**4
