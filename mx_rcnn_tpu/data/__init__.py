@@ -1,0 +1,38 @@
+"""Datasets and input pipeline.
+
+Replaces the reference's L2+L4 stack (SURVEY.md §3.4/§3.6):
+``rcnn/dataset/`` (IMDB/PascalVOC/coco roidb builders),
+``rcnn/utils/load_data.py`` (load/filter/merge roidb),
+``rcnn/io/image.py`` (resize/transform/tensor_vstack) and
+``rcnn/core/loader.py`` (AnchorLoader/ROIIter DataIters).
+
+Two deliberate departures, both TPU-motivated:
+  * images are letterboxed into ONE static canvas per config instead of
+    variable short-side shapes — no executor re-binding (there is no
+    executor), no shape buckets, one compiled program;
+  * anchor labeling is NOT done on host (the reference's assign_anchor in
+    the loader) — it runs in-graph in forward_train; the loader only ships
+    pixels and padded gt boxes.
+"""
+
+from mx_rcnn_tpu.data.datasets import (
+    CocoDataset,
+    SyntheticDataset,
+    VocDataset,
+    build_dataset,
+)
+from mx_rcnn_tpu.data.loader import DetectionLoader
+from mx_rcnn_tpu.data.roidb import filter_roidb, merge_roidb
+from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
+
+__all__ = [
+    "CocoDataset",
+    "DetectionLoader",
+    "SyntheticDataset",
+    "VocDataset",
+    "build_dataset",
+    "filter_roidb",
+    "letterbox",
+    "merge_roidb",
+    "normalize_image",
+]

@@ -1,0 +1,230 @@
+"""Dataset readers producing roidb records.
+
+Replaces ``rcnn/dataset/pascal_voc.py`` (XML parsing → gt_roidb),
+``rcnn/dataset/coco.py`` (pycocotools-backed roidb with the 80↔91 category
+id mapping) and adds a synthetic dataset for hermetic tests/benchmarks (the
+reference has no equivalent — its only test was retraining on real data,
+SURVEY.md §5).
+
+No pycocotools dependency: COCO annotation JSON is indexed directly (the
+eval side has its own mAP implementation in ``evalutil``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import xml.etree.ElementTree as ET
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu.config import DataConfig
+from mx_rcnn_tpu.data.roidb import RoiRecord
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+)
+
+
+class SyntheticDataset:
+    """Deterministic images with geometric objects on noise background.
+
+    Class c ∈ 1..num_classes-1 is a filled axis-aligned shape with a
+    class-specific intensity pattern, so a detector can genuinely learn it —
+    used by the overfit integration test (SURVEY.md §5(c)) and by bench.py
+    (no dataset download in this environment).
+    """
+
+    name = "synthetic"
+
+    def __init__(
+        self,
+        num_images: int = 64,
+        image_hw: tuple[int, int] = (128, 128),
+        num_classes: int = 5,
+        max_objects: int = 4,
+        seed: int = 0,
+    ) -> None:
+        self.num_images = num_images
+        self.image_hw = image_hw
+        self.num_classes = num_classes  # incl. background 0
+        self.max_objects = max_objects
+        self.seed = seed
+        self.classes = ("__background__",) + tuple(
+            f"shape{c}" for c in range(1, num_classes)
+        )
+
+    def _render(self, idx: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.RandomState(self.seed * 100003 + idx)
+        h, w = self.image_hw
+        img = rng.uniform(0, 40, size=(h, w, 3)).astype(np.float32)
+        n = rng.randint(1, self.max_objects + 1)
+        boxes, classes = [], []
+        for _ in range(n):
+            cls = rng.randint(1, self.num_classes)
+            bw = rng.randint(h // 8, h // 2)
+            bh = rng.randint(h // 8, h // 2)
+            x1 = rng.randint(0, w - bw)
+            y1 = rng.randint(0, h - bh)
+            # Class-specific color + texture: stripes along an axis whose
+            # period encodes the class.
+            yy, xx = np.mgrid[y1 : y1 + bh, x1 : x1 + bw]
+            stripe = ((xx // (cls + 1) + yy // (cls + 1)) % 2).astype(np.float32)
+            color = np.array(
+                [80 + 40 * cls, 255 - 35 * cls, 120 + 25 * (cls % 3)], np.float32
+            )
+            img[y1 : y1 + bh, x1 : x1 + bw] = (
+                color * (0.6 + 0.4 * stripe[..., None])
+            )
+            boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
+            classes.append(cls)
+        return img, np.asarray(boxes, np.float32), np.asarray(classes, np.int32)
+
+    def roidb(self) -> list[RoiRecord]:
+        out = []
+        h, w = self.image_hw
+        for i in range(self.num_images):
+            img, boxes, classes = self._render(i)
+            out.append(
+                RoiRecord(
+                    image_id=str(i),
+                    image_path="",
+                    height=h,
+                    width=w,
+                    boxes=boxes,
+                    gt_classes=classes,
+                    image_array=img,
+                )
+            )
+        return out
+
+
+class CocoDataset:
+    """COCO detection annotations without pycocotools.
+
+    Builds the contiguous-id mapping (91 sparse category ids → 1..80) the
+    same way ``rcnn/dataset/coco.py`` does via pycocotools, and keeps
+    segmentation polygons/RLE for the mask head.
+    """
+
+    name = "coco"
+
+    def __init__(self, root: str, split: str = "train2017") -> None:
+        self.root = root
+        self.split = split
+        ann = os.path.join(root, "annotations", f"instances_{split}.json")
+        with open(ann) as f:
+            d = json.load(f)
+        cats = sorted(d["categories"], key=lambda c: c["id"])
+        self.classes = ("__background__",) + tuple(c["name"] for c in cats)
+        self.cat_to_label = {c["id"]: i + 1 for i, c in enumerate(cats)}
+        self.label_to_cat = {v: k for k, v in self.cat_to_label.items()}
+        self._images = {im["id"]: im for im in d["images"]}
+        self._anns: dict[int, list] = {}
+        for a in d["annotations"]:
+            if a.get("iscrowd", 0):
+                continue
+            self._anns.setdefault(a["image_id"], []).append(a)
+
+    def roidb(self) -> list[RoiRecord]:
+        out = []
+        for img_id, im in self._images.items():
+            anns = self._anns.get(img_id, [])
+            boxes, classes, masks = [], [], []
+            for a in anns:
+                x, y, bw, bh = a["bbox"]
+                x2, y2 = x + max(bw - 1, 0), y + max(bh - 1, 0)
+                if bw < 1 or bh < 1:
+                    continue
+                boxes.append([x, y, x2, y2])
+                classes.append(self.cat_to_label[a["category_id"]])
+                masks.append(a.get("segmentation"))
+            out.append(
+                RoiRecord(
+                    image_id=str(img_id),
+                    image_path=os.path.join(
+                        self.root, self.split, im["file_name"]
+                    ),
+                    height=im["height"],
+                    width=im["width"],
+                    boxes=np.asarray(boxes, np.float32).reshape(-1, 4),
+                    gt_classes=np.asarray(classes, np.int32),
+                    masks=masks or None,
+                )
+            )
+        return out
+
+
+class VocDataset:
+    """PASCAL VOC (reference: ``rcnn/dataset/pascal_voc.py``).
+
+    ``split`` is "<year>_<imageset>" e.g. "2007_trainval"; expects the
+    standard VOCdevkit layout under ``root``.
+    """
+
+    name = "voc"
+
+    def __init__(
+        self, root: str, split: str = "2007_trainval", use_diff: bool = False
+    ) -> None:
+        self.root = root
+        year, imageset = split.split("_")
+        self.year, self.imageset = year, imageset
+        self.devkit = os.path.join(root, f"VOC{year}")
+        self.use_diff = use_diff
+        self.classes = ("__background__",) + VOC_CLASSES
+        self._cls_index = {c: i for i, c in enumerate(self.classes)}
+        index_file = os.path.join(
+            self.devkit, "ImageSets", "Main", f"{imageset}.txt"
+        )
+        with open(index_file) as f:
+            self.image_index = [line.strip() for line in f if line.strip()]
+
+    def _parse(self, idx: str) -> RoiRecord:
+        tree = ET.parse(os.path.join(self.devkit, "Annotations", f"{idx}.xml"))
+        size = tree.find("size")
+        h = int(size.find("height").text)
+        w = int(size.find("width").text)
+        boxes, classes = [], []
+        for obj in tree.findall("object"):
+            if not self.use_diff and int(obj.find("difficult").text or 0):
+                continue
+            name = obj.find("name").text.lower().strip()
+            if name not in self._cls_index:
+                continue
+            bb = obj.find("bndbox")
+            # VOC is 1-based pixel coords.
+            boxes.append(
+                [
+                    float(bb.find("xmin").text) - 1,
+                    float(bb.find("ymin").text) - 1,
+                    float(bb.find("xmax").text) - 1,
+                    float(bb.find("ymax").text) - 1,
+                ]
+            )
+            classes.append(self._cls_index[name])
+        return RoiRecord(
+            image_id=idx,
+            image_path=os.path.join(self.devkit, "JPEGImages", f"{idx}.jpg"),
+            height=h,
+            width=w,
+            boxes=np.asarray(boxes, np.float32).reshape(-1, 4),
+            gt_classes=np.asarray(classes, np.int32),
+        )
+
+    def roidb(self) -> list[RoiRecord]:
+        return [self._parse(i) for i in self.image_index]
+
+
+def build_dataset(cfg: DataConfig, split: Optional[str] = None, train: bool = True):
+    split = split or (cfg.train_split if train else cfg.val_split)
+    if cfg.dataset == "synthetic":
+        return SyntheticDataset(image_hw=cfg.image_size)
+    if cfg.dataset == "coco":
+        return CocoDataset(cfg.root, split)
+    if cfg.dataset == "voc":
+        return VocDataset(cfg.root, split)
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")

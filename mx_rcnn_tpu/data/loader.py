@@ -1,0 +1,247 @@
+"""Batch assembly: roidb → statically-shaped Batch pytrees.
+
+Replaces ``rcnn/core/loader.py::AnchorLoader`` minus the anchor labeling
+(in-graph now).  Keeps the reference's load-time behaviors: epoch shuffle,
+aspect-ratio grouping (``ASPECT_GROUPING`` — portrait/landscape batched
+together so letterbox padding is minimized), flip augmentation, per-host
+sharding for data parallelism (the reference slices batches across
+``ctx`` GPUs; here each host process reads ``roidb[rank::world]`` and the
+mesh shards the global batch).  A one-deep background prefetch thread
+overlaps host decode with device compute (the reference relied on MXNet's
+threaded DataIter for the same).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.config import DataConfig
+from mx_rcnn_tpu.data.roidb import RoiRecord
+from mx_rcnn_tpu.data.transforms import (
+    hflip,
+    letterbox,
+    normalize_image,
+    resize_scale,
+)
+from mx_rcnn_tpu.detection.graph import Batch
+
+try:
+    import cv2
+except Exception:  # pragma: no cover
+    cv2 = None
+
+# Box-relative resolution at which gt instance masks are rasterized on host;
+# the device crops these to the mask head's target size per sampled roi.
+GT_MASK_SIZE = 112
+
+
+def _load_image(rec: RoiRecord) -> np.ndarray:
+    if rec.image_array is not None:
+        return rec.image_array
+    if cv2 is None:  # pragma: no cover
+        from PIL import Image
+
+        return np.asarray(Image.open(rec.image_path).convert("RGB"), np.float32)
+    img = cv2.imread(rec.image_path, cv2.IMREAD_COLOR)
+    if img is None:
+        raise FileNotFoundError(rec.image_path)
+    return cv2.cvtColor(img, cv2.COLOR_BGR2RGB).astype(np.float32)
+
+
+def _rasterize_mask(seg, box: np.ndarray) -> np.ndarray:
+    """Polygon/RLE segmentation → (GT_MASK_SIZE,)*2 box-relative float mask."""
+    out = np.zeros((GT_MASK_SIZE, GT_MASK_SIZE), np.float32)
+    if seg is None or cv2 is None:
+        return out
+    x1, y1, x2, y2 = box
+    bw, bh = max(x2 - x1 + 1, 1.0), max(y2 - y1 + 1, 1.0)
+    if isinstance(seg, list):  # polygons in image coords
+        polys = []
+        for p in seg:
+            pts = np.asarray(p, np.float32).reshape(-1, 2)
+            pts[:, 0] = (pts[:, 0] - x1) / bw * GT_MASK_SIZE
+            pts[:, 1] = (pts[:, 1] - y1) / bh * GT_MASK_SIZE
+            polys.append(pts.round().astype(np.int32))
+        cv2.fillPoly(out, polys, 1.0)
+    elif isinstance(seg, dict):  # uncompressed RLE {"counts": [...], "size": [h, w]}
+        h, w = seg["size"]
+        counts = seg["counts"]
+        if isinstance(counts, list):
+            flat = np.zeros(h * w, np.uint8)
+            pos, val = 0, 0
+            for c in counts:
+                flat[pos : pos + c] = val
+                pos += c
+                val = 1 - val
+            full = flat.reshape((w, h)).T.astype(np.float32)
+            crop = full[
+                int(max(y1, 0)) : int(y2) + 1, int(max(x1, 0)) : int(x2) + 1
+            ]
+            if crop.size:
+                out = cv2.resize(crop, (GT_MASK_SIZE, GT_MASK_SIZE))
+    return out
+
+
+class DetectionLoader:
+    """Iterable over statically-shaped Batches.
+
+    train=True: infinite, shuffled per epoch, flip augmentation.
+    train=False: one pass in roidb order, no flip, yields (batch, records)
+    so eval can map detections back to image ids and scales.
+    """
+
+    def __init__(
+        self,
+        roidb: list[RoiRecord],
+        cfg: DataConfig,
+        batch_size: int,
+        train: bool = True,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        with_masks: bool = False,
+        prefetch: bool = True,
+    ) -> None:
+        self.roidb = list(roidb[rank::world]) if world > 1 else list(roidb)
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.train = train
+        self.seed = seed
+        self.with_masks = with_masks
+        self.prefetch = prefetch and train
+        if not self.roidb:
+            raise ValueError("empty roidb shard")
+
+    # -- ordering ----------------------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.roidb)
+        rng = np.random.RandomState(self.seed + epoch)
+        if not self.cfg.aspect_grouping:
+            return rng.permutation(n)
+        # Reference ASPECT_GROUPING: batch wide with wide, tall with tall.
+        aspects = np.array([r.aspect for r in self.roidb])
+        horz = np.flatnonzero(aspects >= 1)
+        vert = np.flatnonzero(aspects < 1)
+        rng.shuffle(horz)
+        rng.shuffle(vert)
+        inds = np.concatenate([horz, vert])
+        # Shuffle whole batches so groups stay contiguous.
+        nb = n // self.batch_size
+        if nb > 0:
+            batches = inds[: nb * self.batch_size].reshape(nb, self.batch_size)
+            batches = batches[rng.permutation(nb)]
+            inds = np.concatenate([batches.reshape(-1), inds[nb * self.batch_size:]])
+        return inds
+
+    # -- single image ------------------------------------------------------
+
+    def _example(self, rec: RoiRecord, flip: bool):
+        img = _load_image(rec)
+        boxes = rec.boxes
+        if flip:
+            img, boxes = hflip(img, boxes, rec.width)
+        img, boxes, scale, (th, tw) = letterbox(
+            img, boxes, self.cfg.image_size, self.cfg.short_side, self.cfg.max_side
+        )
+        img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
+        g = self.cfg.max_gt_boxes
+        n = min(len(boxes), g)
+        gt_boxes = np.zeros((g, 4), np.float32)
+        gt_classes = np.zeros((g,), np.int32)
+        gt_valid = np.zeros((g,), bool)
+        gt_boxes[:n] = boxes[:n]
+        gt_classes[:n] = rec.gt_classes[:n]
+        gt_valid[:n] = True
+        masks = None
+        if self.with_masks:
+            masks = np.zeros((g, GT_MASK_SIZE, GT_MASK_SIZE), np.float32)
+            if rec.masks is not None:
+                for i in range(n):
+                    m = _rasterize_mask(rec.masks[i], rec.boxes[i])
+                    masks[i] = m[:, ::-1] if flip else m
+        return img, (th, tw), gt_boxes, gt_classes, gt_valid, masks, scale
+
+    def _assemble(self, recs: list[RoiRecord], flips: list[bool]) -> Batch:
+        ims, hws, bs, cs, vs, ms = [], [], [], [], [], []
+        for rec, fl in zip(recs, flips):
+            img, (th, tw), gb, gc, gv, gm, _ = self._example(rec, fl)
+            ims.append(img)
+            hws.append([th, tw])
+            bs.append(gb)
+            cs.append(gc)
+            vs.append(gv)
+            if gm is not None:
+                ms.append(gm)
+        return Batch(
+            images=np.stack(ims),
+            image_hw=np.asarray(hws, np.float32),
+            gt_boxes=np.stack(bs),
+            gt_classes=np.stack(cs),
+            gt_valid=np.stack(vs),
+            gt_masks=np.stack(ms) if ms else None,
+        )
+
+    # -- iteration ---------------------------------------------------------
+
+    def _train_batches(self) -> Iterator[Batch]:
+        epoch = 0
+        rng = np.random.RandomState(self.seed + 17)
+        while True:
+            order = self._epoch_order(epoch)
+            for i in range(0, len(order) - self.batch_size + 1, self.batch_size):
+                recs = [self.roidb[j] for j in order[i : i + self.batch_size]]
+                flips = [
+                    self.cfg.flip and bool(rng.randint(2)) for _ in recs
+                ]
+                yield self._assemble(recs, flips)
+            epoch += 1
+
+    def _eval_batches(self):
+        n = len(self.roidb)
+        for i in range(0, n, self.batch_size):
+            recs = self.roidb[i : i + self.batch_size]
+            pad = self.batch_size - len(recs)
+            padded = recs + [recs[-1]] * pad
+            batch = self._assemble(padded, [False] * len(padded))
+            yield batch, recs
+
+    def __iter__(self):
+        if not self.train:
+            return self._eval_batches()
+        it = self._train_batches()
+        if not self.prefetch:
+            return it
+        return _prefetched(it, depth=2)
+
+    def record_scale(self, rec: RoiRecord) -> float:
+        """The letterbox scale applied to a record (for box un-scaling at
+        eval, the reference's ``/ im_scale`` in ``im_detect``)."""
+        return min(
+            resize_scale(rec.height, rec.width, self.cfg.short_side, self.cfg.max_side),
+            self.cfg.image_size[0] / rec.height,
+            self.cfg.image_size[1] / rec.width,
+        )
+
+
+def _prefetched(it: Iterator, depth: int = 2) -> Iterator:
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = object()
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        finally:
+            q.put(stop)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is stop:
+            return
+        yield item

@@ -1,0 +1,73 @@
+"""The roidb record contract and its utilities.
+
+Mirrors the reference's roidb list-of-dicts (``rcnn/dataset/imdb.py``:
+``boxes, gt_classes, flipped, image, height, width``) minus the fields that
+only existed to serve host-side sampling (``gt_overlaps, max_classes,
+max_overlaps`` — IoU matching is in-graph now).  ``flipped`` stays a
+record-level flag (reference: ``append_flipped_images`` doubles the roidb)
+but flipping is applied at load time on pixels+boxes, so no second copy of
+the dataset lives in memory.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class RoiRecord:
+    image_id: str
+    image_path: str            # "" for synthetic/in-memory images
+    height: int
+    width: int
+    boxes: np.ndarray          # (n, 4) float32 x1 y1 x2 y2, unflipped coords
+    gt_classes: np.ndarray     # (n,) int32, 1-based foreground labels
+    flipped: bool = False
+    # Optional instance masks as per-box binary maps in image coords
+    # (COCO polygon/RLE decoded lazily by the dataset).
+    masks: Optional[list] = None
+    # In-memory image for synthetic data.
+    image_array: Optional[np.ndarray] = field(default=None, repr=False)
+
+    @property
+    def aspect(self) -> float:
+        return self.width / max(self.height, 1)
+
+
+def filter_roidb(roidb: list[RoiRecord]) -> list[RoiRecord]:
+    """Drop images without valid gt boxes (reference:
+    ``rcnn/utils/load_data.py::filter_roidb``)."""
+    kept = [r for r in roidb if len(r.boxes) > 0]
+    return kept
+
+
+def merge_roidb(roidbs: list[list[RoiRecord]]) -> list[RoiRecord]:
+    """Concatenate roidbs from several splits (reference: merge_roidb,
+    used for 07+12 VOC training)."""
+    out: list[RoiRecord] = []
+    for r in roidbs:
+        out.extend(r)
+    return out
+
+
+def with_flipped(roidb: list[RoiRecord]) -> list[RoiRecord]:
+    """Append flipped duplicates (reference: append_flipped_images).  Only
+    the flag differs; pixel/box flipping happens in the loader."""
+    flipped = [
+        RoiRecord(
+            image_id=r.image_id,
+            image_path=r.image_path,
+            height=r.height,
+            width=r.width,
+            boxes=r.boxes,
+            gt_classes=r.gt_classes,
+            flipped=True,
+            masks=r.masks,
+            image_array=r.image_array,
+        )
+        for r in roidb
+    ]
+    return list(roidb) + flipped

@@ -1,0 +1,74 @@
+"""Host-side image transforms (numpy/cv2; pixels only — no labeling).
+
+Replaces ``rcnn/io/image.py``: the reference resizes the short side to
+``SCALES`` capped by ``MAX_SIZE`` (variable output shape) and pads at stack
+time (``tensor_vstack``); here :func:`letterbox` produces the final static
+canvas directly.  Box coordinates are scaled by the same factor, exactly as
+``get_rpn_batch`` scales gt by ``im_scale``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # cv2 for fast resize; PIL fallback keeps the module importable anywhere
+    import cv2
+except Exception:  # pragma: no cover
+    cv2 = None
+
+
+def resize_scale(h: int, w: int, short_side: int, max_side: int) -> float:
+    """The reference's scale rule: short side → ``short_side`` unless that
+    pushes the long side past ``max_side``."""
+    scale = short_side / min(h, w)
+    if round(scale * max(h, w)) > max_side:
+        scale = max_side / max(h, w)
+    return scale
+
+
+def letterbox(
+    image: np.ndarray,
+    boxes: np.ndarray,
+    canvas_hw: tuple[int, int],
+    short_side: int,
+    max_side: int,
+) -> tuple[np.ndarray, np.ndarray, float, tuple[int, int]]:
+    """Resize by the reference scale rule and paste top-left into a static
+    canvas.  Returns (canvas, scaled_boxes, scale, (true_h, true_w))."""
+    h, w = image.shape[:2]
+    ch, cw = canvas_hw
+    scale = resize_scale(h, w, short_side, max_side)
+    # Never overflow the canvas (canvas is sized for max_side but guard
+    # rounding).
+    scale = min(scale, ch / h, cw / w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    if cv2 is not None:
+        resized = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    else:  # pragma: no cover
+        from PIL import Image
+
+        resized = np.asarray(
+            Image.fromarray(image.astype(np.uint8)).resize((nw, nh))
+        )
+    canvas = np.zeros((ch, cw, 3), dtype=np.float32)
+    canvas[:nh, :nw] = resized
+    out_boxes = boxes.astype(np.float32) * scale
+    return canvas, out_boxes, scale, (nh, nw)
+
+
+def normalize_image(
+    image: np.ndarray, mean: tuple[float, ...], std: tuple[float, ...]
+) -> np.ndarray:
+    """(x - mean) / std channelwise; RGB order (reference used raw BGR
+    mean-subtraction — the constant differs, the op is the same)."""
+    return (image - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+
+
+def hflip(image: np.ndarray, boxes: np.ndarray, width: int):
+    """Horizontal flip of pixels + boxes (reference: flipped roidb entries
+    remap x1,x2 = w-1-x2, w-1-x1 at batch time)."""
+    out = image[:, ::-1].copy()
+    fb = boxes.copy()
+    fb[:, 0] = width - 1 - boxes[:, 2]
+    fb[:, 2] = width - 1 - boxes[:, 0]
+    return out, fb

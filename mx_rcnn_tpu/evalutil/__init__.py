@@ -1,0 +1,23 @@
+"""Detection evaluation (host-side, numpy).
+
+Replaces the reference's evaluation stack: ``rcnn/dataset/pascal_voc_eval.py``
+(classic VOC AP), the vendored ``rcnn/pycocotools`` (COCO mAP@[.5:.95] —
+re-implemented here from the metric definition because pycocotools is not
+installed in this environment), ``rcnn/core/tester.py::pred_eval`` (the
+predict→NMS→accumulate loop) and ``rcnn/tools/reeval.py`` (re-score cached
+detections).
+"""
+
+from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
+from mx_rcnn_tpu.evalutil.detections import load_detections, save_detections
+from mx_rcnn_tpu.evalutil.pred_eval import pred_eval
+from mx_rcnn_tpu.evalutil.voc_eval import voc_ap, voc_eval
+
+__all__ = [
+    "CocoEvaluator",
+    "load_detections",
+    "pred_eval",
+    "save_detections",
+    "voc_ap",
+    "voc_eval",
+]

@@ -1,0 +1,226 @@
+"""Self-contained COCO-style detection evaluator (numpy).
+
+Re-implements the COCO bbox metric from its public definition — the
+reference reaches it through vendored pycocotools
+(``rcnn/pycocotools/cocoeval.py``; not installed in this image): per
+(category, IoU∈0.5:0.05:0.95, area range, maxDets) greedy score-ordered
+matching, 101-point interpolated AP, and the standard 12-number summary
+(AP, AP50, AP75, APs/m/l, AR1/10/100, ARs/m/l).
+
+Differences kept deliberately: crowd annotations are dropped at roidb build
+time (the reference's loader also skips them for training; for strict
+leaderboard parity crowd-ignore matching would be added here).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+RECALL_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+MAX_DETS = (1, 10, 100)
+
+
+def _xyxy_iou(d: np.ndarray, g: np.ndarray) -> np.ndarray:
+    """(n, 4) x (m, 4) → (n, m) IoU (continuous coords, no +1: COCO
+    convention, unlike the VOC evaluator's integer-pixel +1)."""
+    ix1 = np.maximum(d[:, None, 0], g[None, :, 0])
+    iy1 = np.maximum(d[:, None, 1], g[None, :, 1])
+    ix2 = np.minimum(d[:, None, 2], g[None, :, 2])
+    iy2 = np.minimum(d[:, None, 3], g[None, :, 3])
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    ad = (d[:, 2] - d[:, 0]) * (d[:, 3] - d[:, 1])
+    ag = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1])
+    return inter / np.maximum(ad[:, None] + ag[None, :] - inter, 1e-10)
+
+
+class CocoEvaluator:
+    """Accumulate per-image detections + gt, then summarize.
+
+    add_image() per image; summarize() → the 12 COCO numbers plus
+    per-category AP.  Labels are contiguous 1-based category indices.
+    """
+
+    def __init__(self, num_classes: int) -> None:
+        self.num_classes = num_classes  # incl. background 0
+        # (cat, image) → dict(dt=..., gt=..., iou=...)
+        self._dts: dict = defaultdict(list)
+        self._gts: dict = defaultdict(list)
+        self._images: set = set()
+
+    def add_image(
+        self,
+        image_id,
+        det_boxes: np.ndarray,    # (n, 4) xyxy in ORIGINAL image coords
+        det_scores: np.ndarray,   # (n,)
+        det_classes: np.ndarray,  # (n,) 1-based
+        gt_boxes: np.ndarray,     # (m, 4)
+        gt_classes: np.ndarray,   # (m,)
+    ) -> None:
+        self._images.add(image_id)
+        det_boxes = np.asarray(det_boxes, float).reshape(-1, 4)
+        gt_boxes = np.asarray(gt_boxes, float).reshape(-1, 4)
+        for c in range(1, self.num_classes):
+            dm = np.asarray(det_classes) == c
+            gm = np.asarray(gt_classes) == c
+            if dm.any():
+                self._dts[(c, image_id)] = (
+                    det_boxes[dm], np.asarray(det_scores, float)[dm]
+                )
+            if gm.any():
+                self._gts[(c, image_id)] = gt_boxes[gm]
+
+    # -- matching ----------------------------------------------------------
+
+    def _evaluate_img(self, cat: int, img, area_rng, max_det: int):
+        dt = self._dts.get((cat, img))
+        gt = self._gts.get((cat, img))
+        if dt is None and gt is None:
+            return None
+        if dt is None:
+            dboxes = np.zeros((0, 4))
+            dscores = np.zeros(0)
+        else:
+            dboxes, dscores = dt
+            order = np.argsort(-dscores, kind="mergesort")[:max_det]
+            dboxes, dscores = dboxes[order], dscores[order]
+        gboxes = gt if gt is not None else np.zeros((0, 4))
+
+        garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+        g_ignore = (garea < area_rng[0]) | (garea > area_rng[1])
+        # Sort gt: non-ignored first (COCO matches real gt preferentially).
+        g_order = np.argsort(g_ignore, kind="mergesort")
+        gboxes, g_ignore = gboxes[g_order], g_ignore[g_order]
+
+        ious = _xyxy_iou(dboxes, gboxes)
+        T, D, G = len(IOU_THRS), len(dboxes), len(gboxes)
+        dt_match = np.zeros((T, D), dtype=np.int64)  # 1 + matched gt idx, 0 = none
+        gt_match = np.zeros((T, G), dtype=np.int64)
+        for ti, t in enumerate(IOU_THRS):
+            for di in range(D):
+                best, best_j = min(t, 1 - 1e-10), -1
+                for gi in range(G):
+                    if gt_match[ti, gi] and not g_ignore[gi]:
+                        continue
+                    # Past non-ignored best, stop upgrading to ignored gt.
+                    if best_j > -1 and not g_ignore[best_j] and g_ignore[gi]:
+                        break
+                    if ious[di, gi] < best:
+                        continue
+                    best, best_j = ious[di, gi], gi
+                if best_j > -1:
+                    dt_match[ti, di] = best_j + 1
+                    gt_match[ti, best_j] = di + 1
+        darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+        # Unmatched dets outside the area range are ignored, matched-to-
+        # ignored-gt dets are ignored.
+        dt_ignore = np.zeros((T, D), bool)
+        for ti in range(T):
+            for di in range(D):
+                j = dt_match[ti, di] - 1
+                if j >= 0:
+                    dt_ignore[ti, di] = g_ignore[j]
+                else:
+                    dt_ignore[ti, di] = (darea[di] < area_rng[0]) | (
+                        darea[di] > area_rng[1]
+                    )
+        return {
+            "scores": dscores,
+            "dt_match": dt_match,
+            "dt_ignore": dt_ignore,
+            "num_gt": int((~g_ignore).sum()),
+        }
+
+    def _accumulate(self, cat: int, area: str, max_det: int):
+        """→ (precision (T, R), recall (T,)) or None if no gt anywhere."""
+        per_img = [
+            r
+            for img in self._images
+            if (r := self._evaluate_img(cat, img, AREA_RANGES[area], max_det))
+        ]
+        if not per_img:
+            return None
+        npos = sum(r["num_gt"] for r in per_img)
+        if npos == 0:
+            return None
+        scores = np.concatenate([r["scores"] for r in per_img])
+        order = np.argsort(-scores, kind="mergesort")
+        T = len(IOU_THRS)
+        matches = np.concatenate([r["dt_match"] for r in per_img], axis=1)[:, order]
+        ignores = np.concatenate([r["dt_ignore"] for r in per_img], axis=1)[:, order]
+
+        precision = np.zeros((T, len(RECALL_THRS)))
+        recall = np.zeros(T)
+        for ti in range(T):
+            keep = ~ignores[ti]
+            tps = np.cumsum((matches[ti] > 0) & keep)
+            fps = np.cumsum((matches[ti] == 0) & keep)
+            rc = tps / npos
+            pr = tps / np.maximum(tps + fps, 1e-10)
+            if len(rc):
+                recall[ti] = rc[-1]
+            # Monotone non-increasing precision envelope.
+            for i in range(len(pr) - 1, 0, -1):
+                pr[i - 1] = max(pr[i - 1], pr[i])
+            idx = np.searchsorted(rc, RECALL_THRS, side="left")
+            valid = idx < len(pr)
+            precision[ti, valid] = pr[idx[valid]]
+        return precision, recall
+
+    # -- summary -----------------------------------------------------------
+
+    def summarize(self) -> dict[str, float]:
+        cats = range(1, self.num_classes)
+        acc = {
+            (c, a, m): self._accumulate(c, a, m)
+            for c in cats
+            for a in AREA_RANGES
+            for m in MAX_DETS
+            if a == "all" or m == 100  # COCO only varies one of the two
+        }
+
+        def mean_ap(area: str, max_det: int, iou_idx=None) -> float:
+            vals = []
+            for c in cats:
+                r = acc.get((c, area, max_det))
+                if r is None:
+                    continue
+                p = r[0] if iou_idx is None else r[0][iou_idx : iou_idx + 1]
+                vals.append(np.mean(p))
+            return float(np.mean(vals)) if vals else -1.0
+
+        def mean_ar(area: str, max_det: int) -> float:
+            vals = [
+                np.mean(r[1])
+                for c in cats
+                if (r := acc.get((c, area, max_det))) is not None
+            ]
+            return float(np.mean(vals)) if vals else -1.0
+
+        out = {
+            "AP": mean_ap("all", 100),
+            "AP50": mean_ap("all", 100, iou_idx=0),
+            "AP75": mean_ap("all", 100, iou_idx=5),
+            "APs": mean_ap("small", 100),
+            "APm": mean_ap("medium", 100),
+            "APl": mean_ap("large", 100),
+            "AR1": mean_ar("all", 1),
+            "AR10": mean_ar("all", 10),
+            "AR100": mean_ar("all", 100),
+            "ARs": mean_ar("small", 100),
+            "ARm": mean_ar("medium", 100),
+            "ARl": mean_ar("large", 100),
+        }
+        for c in cats:
+            r = acc.get((c, "all", 100))
+            if r is not None:
+                out[f"AP/class_{c}"] = float(np.mean(r[0]))
+        return out

@@ -1,0 +1,110 @@
+"""The evaluation loop: model → detections → dataset metric.
+
+Replaces ``rcnn/core/tester.py::pred_eval`` (Predictor loop, per-class NMS,
+all_boxes accumulation, ``imdb.evaluate_detections``).  NMS and score
+thresholding already happened in-graph (``forward_inference``); here we only
+un-letterbox boxes back to original image coordinates (the reference's
+``/ im_scale``) and feed the evaluator.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.data.loader import DetectionLoader
+from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
+from mx_rcnn_tpu.evalutil.detections import save_detections
+from mx_rcnn_tpu.evalutil.voc_eval import voc_mean_ap
+
+
+def collect_detections(
+    eval_step: Callable,
+    variables,
+    loader: DetectionLoader,
+    progress: Optional[Callable[[int], None]] = None,
+) -> dict[str, dict]:
+    """Run inference over the loader; → image_id → original-coord results."""
+    out: dict[str, dict] = {}
+    done = 0
+    for batch, recs in loader:
+        dets = jax.device_get(eval_step(variables, jax.tree_util.tree_map(np.asarray, batch)))
+        for i, rec in enumerate(recs):
+            scale = loader.record_scale(rec)
+            valid = np.asarray(dets.valid[i])
+            boxes = np.asarray(dets.boxes[i])[valid] / scale
+            # Clip to original extents (letterbox canvas may exceed them).
+            boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, rec.width - 1)
+            boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, rec.height - 1)
+            out[rec.image_id] = {
+                "boxes": boxes,
+                "scores": np.asarray(dets.scores[i])[valid],
+                "classes": np.asarray(dets.classes[i])[valid],
+            }
+            done += 1
+            if progress:
+                progress(done)
+    return out
+
+
+def evaluate_detections(
+    per_image: dict[str, dict],
+    roidb,
+    num_classes: int,
+    style: str = "coco",
+    class_names: Optional[tuple] = None,
+    use_07_metric: bool = False,
+) -> dict[str, float]:
+    """Score cached detections against roidb gt (reeval parity: callable on
+    loaded detections with no model)."""
+    if style == "coco":
+        ev = CocoEvaluator(num_classes)
+        for rec in roidb:
+            d = per_image.get(
+                rec.image_id,
+                {"boxes": np.zeros((0, 4)), "scores": np.zeros(0), "classes": np.zeros(0)},
+            )
+            ev.add_image(
+                rec.image_id, d["boxes"], d["scores"], d["classes"],
+                rec.boxes, rec.gt_classes,
+            )
+        return ev.summarize()
+    if style == "voc":
+        all_dets: dict[int, dict] = {c: {} for c in range(1, num_classes)}
+        all_gt: dict[int, dict] = {c: {} for c in range(1, num_classes)}
+        for rec in roidb:
+            d = per_image.get(rec.image_id)
+            for c in range(1, num_classes):
+                if d is not None:
+                    m = d["classes"] == c
+                    if m.any():
+                        all_dets[c][rec.image_id] = np.concatenate(
+                            [d["boxes"][m], d["scores"][m, None]], axis=1
+                        )
+                gm = rec.gt_classes == c
+                if gm.any():
+                    all_gt[c][rec.image_id] = {"boxes": rec.boxes[gm]}
+        names = class_names or tuple(str(i) for i in range(num_classes))
+        return voc_mean_ap(all_dets, all_gt, names, use_07_metric=use_07_metric)
+    raise ValueError(f"unknown eval style {style!r}")
+
+
+def pred_eval(
+    eval_step: Callable,
+    variables,
+    loader: DetectionLoader,
+    roidb,
+    num_classes: int,
+    style: str = "coco",
+    class_names: Optional[tuple] = None,
+    use_07_metric: bool = False,
+    dump_path: Optional[str] = None,
+) -> dict[str, float]:
+    per_image = collect_detections(eval_step, variables, loader)
+    if dump_path:
+        save_detections(dump_path, per_image)
+    return evaluate_detections(
+        per_image, roidb, num_classes, style, class_names, use_07_metric
+    )

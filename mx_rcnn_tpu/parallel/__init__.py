@@ -1,0 +1,30 @@
+"""Device-mesh parallelism.
+
+Replaces the reference's entire parallel stack (SURVEY.md §3.8): the
+``Module(context=[mx.gpu(i)...])`` per-device batch slicing in
+``rcnn/core/loader.py``, and the KVStore gradient aggregation
+(``local``/``device`` single-host, ``dist_sync`` ps-lite multi-host).  On
+TPU there is no parameter server and no push/pull: parameters are
+replicated over a 1-D data mesh, batches are sharded along it, and XLA
+inserts the gradient all-reduce over ICI (DCN across slices) when it
+compiles the jitted train step.  Synchronous and deterministic — the
+semantic equivalent of ``dist_sync`` + ``device`` aggregation with none of
+the machinery.
+"""
+
+from mx_rcnn_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from mx_rcnn_tpu.parallel.step import make_eval_step, make_train_step
+
+__all__ = [
+    "batch_sharding",
+    "make_eval_step",
+    "make_mesh",
+    "make_train_step",
+    "replicated",
+    "shard_batch",
+]

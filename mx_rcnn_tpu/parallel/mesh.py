@@ -1,0 +1,62 @@
+"""Mesh construction and sharding specs.
+
+The data axis is the only required axis for reference parity (it only ever
+does data parallelism); the mesh is built (data, model) so tensor-parallel
+shardings can be layered in without re-plumbing.  Multi-host: every process
+calls :func:`make_mesh` over ``jax.devices()`` (global), and
+:func:`shard_batch` builds global arrays from per-host shards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None, model_parallel: int = 1
+) -> Mesh:
+    """(data, model) mesh over all devices; model_parallel=1 → pure DP.
+
+    Adjacent device ids share the model axis so model-parallel collectives
+    ride the shortest ICI hops.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim split over the data axis, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch onto the mesh, batch dim over the data axis.
+
+    Single-process: a plain device_put with the named sharding.
+    Multi-process: each host holds its local slice of the global batch and
+    jax assembles the global array (the per-host input sharding the
+    reference gets from per-worker KVStore ranks).
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )

@@ -1,0 +1,78 @@
+"""Jitted, sharded train and eval steps.
+
+Replaces the reference's per-iteration runtime (SURVEY.md §4.1 hot loop):
+``MutableModule.forward/backward/update`` + KVStore push/pull per parameter.
+One compiled XLA program does forward, backward, gradient all-reduce (ICI)
+and the optimizer update; there is no per-parameter communication schedule
+to manage because XLA fuses the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import optax
+from jax.sharding import Mesh
+
+from mx_rcnn_tpu.detection.detector import TwoStageDetector
+from mx_rcnn_tpu.detection.graph import Batch, forward_inference, forward_train
+from mx_rcnn_tpu.parallel.mesh import batch_sharding, replicated
+from mx_rcnn_tpu.train.state import TrainState, state_variables
+
+
+def make_train_step(
+    model: TwoStageDetector,
+    tx: optax.GradientTransformation,
+    schedule=None,
+    mesh: Optional[Mesh] = None,
+):
+    """Build ``step(state, batch) -> (state, metrics)``.
+
+    With a mesh: state replicated, batch sharded over the data axis; the
+    gradient all-reduce is implicit in XLA's SPMD partitioning (grads of
+    replicated params w.r.t. a sharded batch).  Without: plain single-device
+    jit.  State buffers are donated — params update in place in HBM.
+    """
+
+    def step(state: TrainState, batch: Batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params, **state.model_state}
+            total, metrics = forward_train(model, variables, rng, batch)
+            return total, metrics
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads, tx)
+        if schedule is not None:
+            metrics = dict(metrics, lr=schedule(state.step))
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+    rep, data = replicated(mesh), batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(rep, data),
+        out_shardings=(rep, rep),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(model: TwoStageDetector, mesh: Optional[Mesh] = None):
+    """Build ``eval_step(variables, batch) -> Detections`` (jitted)."""
+
+    def step(variables, batch: Batch):
+        return forward_inference(model, variables, batch)
+
+    if mesh is None:
+        return jax.jit(step)
+    rep, data = replicated(mesh), batch_sharding(mesh)
+    return jax.jit(step, in_shardings=(rep, data), out_shardings=(data,))
+
+
+def eval_variables(state: TrainState) -> dict:
+    """Inference variables from a train state (no weight folding needed —
+    see train/checkpoint.py docstring)."""
+    return state_variables(state)

@@ -1,0 +1,31 @@
+"""Training runtime: optimizer, schedule, state, metrics, checkpointing.
+
+Replaces the reference's L1 runtime (SURVEY.md §3.7): ``rcnn/core/module.py``
+(MutableModule fit loop), ``rcnn/core/metric.py`` (six EvalMetrics),
+``rcnn/core/callback.py`` (Speedometer + do_checkpoint) and
+``rcnn/utils/load_model.py`` / ``save_model.py`` (param I/O).  Instead of an
+executor-rebinding module and per-epoch NDArray dict dumps, training state is
+one pytree (params + optimizer state + step + rng) updated by a pure jitted
+step and checkpointed atomically with orbax.
+"""
+
+from mx_rcnn_tpu.train.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.train.metrics import MetricAccumulator, Speedometer
+from mx_rcnn_tpu.train.optim import make_optimizer, make_schedule
+from mx_rcnn_tpu.train.state import TrainState, create_train_state
+
+__all__ = [
+    "MetricAccumulator",
+    "Speedometer",
+    "TrainState",
+    "create_train_state",
+    "latest_step",
+    "make_optimizer",
+    "make_schedule",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,63 @@
+"""Checkpoint save/restore via orbax.
+
+Replaces the reference's ``mx.model.save_checkpoint`` (per-epoch
+``prefix-symbol.json`` + ``prefix-NNNN.params`` NDArray dumps written by
+``rcnn/core/callback.py::do_checkpoint``) and ``load_param`` /
+``load_checkpoint`` (``rcnn/utils/load_model.py``).  One atomic pytree per
+step: params + frozen-BN state + optimizer state + step + rng — resume is
+bit-exact including momentum, which the reference loses (SURVEY.md §6).
+
+The reference folds BBOX_MEANS/STDS into the bbox_pred weights at save time
+so inference needs no un-normalization; our decode applies
+``cfg.rcnn.bbox_weights`` in-graph instead, so checkpoints are always in
+training parameterization and no folding step exists to get wrong.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from mx_rcnn_tpu.train.state import TrainState
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 5) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+    )
+
+
+def save_checkpoint(ckpt_dir: str, state: TrainState, *, wait: bool = False) -> None:
+    mgr = _manager(ckpt_dir)
+    mgr.save(int(state.step), args=ocp.args.StandardSave(state))
+    if wait:
+        mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_checkpoint(
+    ckpt_dir: str, target: TrainState, step: Optional[int] = None
+) -> TrainState:
+    """Restore into the structure of ``target`` (shapes/dtypes from it)."""
+    mgr = _manager(ckpt_dir)
+    if step is None:
+        step = mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return restored

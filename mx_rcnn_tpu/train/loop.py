@@ -1,0 +1,122 @@
+"""The training loop.
+
+Replaces ``MutableModule.fit`` + the driver body of ``train_end2end.py``
+(SURVEY.md §4.1): one function that wires loader → sharded step → metrics →
+checkpoints.  Reused verbatim by every training mode — end-to-end, the
+RPN/RCNN phases of alternate training (phase behavior is expressed through
+the config's loss weights and freeze prefixes, not separate code paths) —
+where the reference re-implements the loop per tool
+(``rcnn/tools/train_rpn.py``, ``train_rcnn.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data import DetectionLoader, build_dataset, filter_roidb
+from mx_rcnn_tpu.detection import TwoStageDetector
+from mx_rcnn_tpu.parallel import make_mesh, make_train_step, replicated, shard_batch
+from mx_rcnn_tpu.train.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from mx_rcnn_tpu.train.metrics import Speedometer, device_metrics_to_host
+from mx_rcnn_tpu.train.optim import make_optimizer
+from mx_rcnn_tpu.train.state import TrainState, create_train_state
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+# fixed_param_prefix equivalents per backbone (reference: conv1/res2 frozen
+# for ResNet, conv1_/conv2_ for VGG — train_end2end.py arg defaults).
+FREEZE_PREFIXES = {
+    "resnet50": ("conv1", "bn1", "layer1"),
+    "resnet101": ("conv1", "bn1", "layer1"),
+    "vgg16": ("conv1", "conv2"),
+}
+
+
+def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
+              extra_freeze: tuple[str, ...] = ()):
+    """Model + optimizer + fresh state + sharded step for a config."""
+    model = TwoStageDetector(cfg=cfg.model)
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    n_dev = mesh.size if mesh is not None else 1
+    global_batch = cfg.train.per_device_batch * n_dev
+    lr_scale = global_batch / 16.0
+    freeze = ()
+    if freeze_backbone and cfg.model.backbone.freeze_stages > 0:
+        freeze = FREEZE_PREFIXES.get(cfg.model.backbone.name, ())
+    freeze = tuple(freeze) + tuple(extra_freeze)
+
+    # Init params first (on host) so the freeze mask can see the tree.
+    probe_tx, schedule = make_optimizer(cfg.train, None, lr_scale=lr_scale)
+    state = create_train_state(model, probe_tx, rng, cfg.data.image_size, batch=1)
+    if freeze:
+        tx, schedule = make_optimizer(
+            cfg.train, state.params, lr_scale=lr_scale, freeze_prefixes=freeze
+        )
+        state = state.replace(opt_state=tx.init(state.params))
+    else:
+        tx = probe_tx
+    step_fn = make_train_step(model, tx, schedule, mesh=mesh)
+    return model, tx, state, step_fn, global_batch
+
+
+def train(
+    cfg: Config,
+    mesh=None,
+    total_steps: Optional[int] = None,
+    workdir: Optional[str] = None,
+    resume: bool = False,
+    state: Optional[TrainState] = None,
+    extra_freeze: tuple[str, ...] = (),
+    loader: Optional[DetectionLoader] = None,
+) -> TrainState:
+    """Train for ``total_steps`` (default: cfg schedule length); returns the
+    final state (host-fetchable).  Pass ``state`` to continue from an earlier
+    phase (alternate training), ``resume`` to restore from workdir."""
+    if mesh is None and jax.device_count() > 1:
+        mesh = make_mesh()
+    model, tx, fresh_state, step_fn, global_batch = build_all(
+        cfg, mesh, extra_freeze=extra_freeze
+    )
+    if state is None:
+        state = fresh_state
+    steps = total_steps if total_steps is not None else cfg.train.schedule.total_steps
+    ckpt_dir = f"{workdir or cfg.workdir}/{cfg.name}/ckpt"
+    if resume and latest_step(ckpt_dir) is not None:
+        state = restore_checkpoint(ckpt_dir, state)
+        log.info("resumed from %s at step %d", ckpt_dir, int(state.step))
+
+    if loader is None:
+        roidb = filter_roidb(build_dataset(cfg.data, train=True).roidb())
+        loader = DetectionLoader(
+            roidb,
+            cfg.data,
+            batch_size=global_batch,
+            train=True,
+            seed=cfg.train.seed,
+            rank=jax.process_index(),
+            world=jax.process_count(),
+            with_masks=cfg.model.mask.enabled,
+        )
+    if mesh is not None:
+        state = jax.device_put(state, replicated(mesh))
+
+    speedo = Speedometer(global_batch, cfg.train.log_every)
+    start = int(state.step)
+    it = iter(loader)
+    for i in range(start, steps):
+        batch = next(it)
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        state, metrics = step_fn(state, batch)
+        if (i + 1) % cfg.train.log_every == 0 or i == start:
+            speedo(i + 1, device_metrics_to_host(metrics))
+        if workdir and (i + 1) % cfg.train.checkpoint_every == 0:
+            save_checkpoint(ckpt_dir, jax.device_get(state))
+    if workdir:
+        save_checkpoint(ckpt_dir, jax.device_get(state), wait=True)
+    return state

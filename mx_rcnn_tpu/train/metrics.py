@@ -1,0 +1,67 @@
+"""Host-side metric accumulation and throughput logging.
+
+Replaces ``rcnn/core/metric.py`` (RPNAcc / RPNLogLoss / RPNL1Loss /
+RCNNAcc / RCNNLogLoss / RCNNL1Loss EvalMetrics — here the same six scalars
+are computed in-graph by ``detection.graph.forward_train`` and merely
+averaged on host) and ``rcnn/core/callback.py::Speedometer`` (samples/sec
+every ``frequent`` batches).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+
+class MetricAccumulator:
+    """Running means of scalar metrics between log points."""
+
+    def __init__(self) -> None:
+        self._sums: dict[str, float] = {}
+        self._count = 0
+
+    def update(self, metrics: dict) -> None:
+        for k, v in metrics.items():
+            self._sums[k] = self._sums.get(k, 0.0) + float(v)
+        self._count += 1
+
+    def summary(self) -> dict[str, float]:
+        n = max(self._count, 1)
+        return {k: s / n for k, s in self._sums.items()}
+
+    def reset(self) -> None:
+        self._sums.clear()
+        self._count = 0
+
+
+class Speedometer:
+    """samples/sec + metric line every ``frequent`` steps (reference
+    semantics; prints through logging, not stdout)."""
+
+    def __init__(self, batch_size: int, frequent: int = 20) -> None:
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._acc = MetricAccumulator()
+        self._tic = time.monotonic()
+
+    def __call__(self, step: int, metrics: dict) -> None:
+        self._acc.update(metrics)
+        if step % self.frequent != 0:
+            return
+        elapsed = time.monotonic() - self._tic
+        speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+        parts = ", ".join(f"{k}={v:.4f}" for k, v in self._acc.summary().items())
+        log.info("step %d speed %.2f samples/sec %s", step, speed, parts)
+        self._acc.reset()
+        self._tic = time.monotonic()
+
+
+def device_metrics_to_host(metrics: dict) -> dict[str, float]:
+    """One blocking transfer for the whole metric dict."""
+    flat = jax.device_get(metrics)
+    return {k: float(np.asarray(v)) for k, v in flat.items()}

@@ -1,0 +1,58 @@
+"""Training state as a single pytree.
+
+Replaces the reference's scattered state — module arg/aux param dicts,
+optimizer state living inside MXNet's updater, epoch counters in the driver
+(``rcnn/core/module.py``, ``rcnn/utils/load_model.py``) — with one
+checkpointable struct.  Note the reference does NOT checkpoint optimizer
+state (momentum restarts on resume, SURVEY.md §6); we do, which is strictly
+better and free with a pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # () int32 global step
+    params: Any                       # trainable + frozen params pytree
+    model_state: Any                  # non-param collections (frozen-BN stats)
+    opt_state: optax.OptState
+    rng: jax.Array                    # per-step folding base
+
+    def apply_gradients(self, grads, tx: optax.GradientTransformation):
+        updates, new_opt = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt
+        )
+
+
+def create_train_state(
+    model, tx: optax.GradientTransformation, rng: jax.Array, image_size, batch: int = 1
+) -> TrainState:
+    """Initialize variables and optimizer state on the host."""
+    from mx_rcnn_tpu.detection.graph import init_detector
+
+    init_rng, step_rng = jax.random.split(rng)
+    variables = init_detector(model, init_rng, image_size, batch=batch)
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=tx.init(params),
+        rng=step_rng,
+    )
+
+
+def state_variables(state: TrainState) -> dict:
+    """Rebuild the flax ``variables`` dict for model.apply."""
+    return {"params": state.params, **state.model_state}

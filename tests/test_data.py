@@ -1,0 +1,194 @@
+"""Tests for the data layer: transforms, datasets, loader batching."""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import DataConfig
+from mx_rcnn_tpu.data import (
+    CocoDataset,
+    DetectionLoader,
+    SyntheticDataset,
+    VocDataset,
+    filter_roidb,
+    merge_roidb,
+)
+from mx_rcnn_tpu.data.roidb import RoiRecord, with_flipped
+from mx_rcnn_tpu.data.transforms import hflip, letterbox, resize_scale
+
+
+class TestTransforms:
+    def test_resize_scale_short_side(self):
+        # 480x640 → short 600: scale 1.25, long side 800 <= 1000.
+        assert np.isclose(resize_scale(480, 640, 600, 1000), 1.25)
+
+    def test_resize_scale_max_cap(self):
+        # 400x1200 → short-side rule gives 1.5 → long 1800 > 1000 → cap.
+        assert np.isclose(resize_scale(400, 1200, 600, 1000), 1000 / 1200)
+
+    def test_letterbox_boxes_scaled(self):
+        img = np.ones((100, 200, 3), np.float32)
+        boxes = np.array([[10, 10, 50, 50]], np.float32)
+        canvas, out, scale, (th, tw) = letterbox(img, boxes, (256, 256), 128, 256)
+        assert canvas.shape == (256, 256, 3)
+        assert np.isclose(scale, 1.28)  # short 100→128
+        np.testing.assert_allclose(out, boxes * scale)
+        assert (th, tw) == (128, 256)
+        # Padding region is zero.
+        assert np.all(canvas[th:] == 0)
+
+    def test_hflip_involution(self):
+        img = np.random.rand(8, 10, 3).astype(np.float32)
+        boxes = np.array([[1, 2, 4, 6]], np.float32)
+        img2, boxes2 = hflip(*hflip(img, boxes, 10), 10)
+        np.testing.assert_allclose(img2, img)
+        np.testing.assert_allclose(boxes2, boxes)
+
+
+class TestSynthetic:
+    def test_deterministic(self):
+        a = SyntheticDataset(num_images=4, seed=3).roidb()
+        b = SyntheticDataset(num_images=4, seed=3).roidb()
+        for ra, rb in zip(a, b):
+            np.testing.assert_allclose(ra.image_array, rb.image_array)
+            np.testing.assert_allclose(ra.boxes, rb.boxes)
+
+    def test_boxes_in_bounds(self):
+        for r in SyntheticDataset(num_images=8, image_hw=(96, 128)).roidb():
+            assert np.all(r.boxes[:, [0, 2]] < 128)
+            assert np.all(r.boxes[:, [1, 3]] < 96)
+            assert np.all(r.boxes >= 0)
+            assert np.all(r.gt_classes >= 1)
+
+
+class TestRoidbUtils:
+    def test_filter_and_merge(self):
+        empty = RoiRecord("a", "", 10, 10, np.zeros((0, 4), np.float32), np.zeros(0, np.int32))
+        full = RoiRecord("b", "", 10, 10, np.ones((1, 4), np.float32), np.ones(1, np.int32))
+        assert filter_roidb([empty, full]) == [full]
+        assert len(merge_roidb([[full], [full, full]])) == 3
+
+    def test_with_flipped_doubles(self):
+        full = RoiRecord("b", "", 10, 10, np.ones((1, 4), np.float32), np.ones(1, np.int32))
+        out = with_flipped([full])
+        assert len(out) == 2 and out[1].flipped and not out[0].flipped
+
+
+def _loader_cfg(**kw):
+    base = dict(
+        dataset="synthetic", image_size=(128, 128), short_side=128,
+        max_side=128, max_gt_boxes=8, flip=True,
+    )
+    base.update(kw)
+    return DataConfig(**base)
+
+
+class TestLoader:
+    def test_train_batch_shapes(self):
+        roidb = SyntheticDataset(num_images=8).roidb()
+        loader = DetectionLoader(roidb, _loader_cfg(), batch_size=2, prefetch=False)
+        batch = next(iter(loader))
+        assert batch.images.shape == (2, 128, 128, 3)
+        assert batch.gt_boxes.shape == (2, 8, 4)
+        assert batch.gt_classes.shape == (2, 8)
+        assert batch.gt_valid.shape == (2, 8)
+        assert batch.gt_valid.any()
+
+    def test_eval_pass_covers_all_records(self):
+        roidb = SyntheticDataset(num_images=5).roidb()
+        loader = DetectionLoader(roidb, _loader_cfg(), batch_size=2, train=False)
+        seen = []
+        for batch, recs in loader:
+            assert batch.images.shape[0] == 2  # padded to full batch
+            seen += [r.image_id for r in recs]
+        assert seen == [r.image_id for r in roidb]
+
+    def test_host_sharding_partitions(self):
+        roidb = SyntheticDataset(num_images=8).roidb()
+        ids = set()
+        for rank in range(2):
+            shard = DetectionLoader(
+                roidb, _loader_cfg(), batch_size=1, rank=rank, world=2, prefetch=False
+            )
+            ids |= {r.image_id for r in shard.roidb}
+        assert len(ids) == 8
+
+    def test_masks_batched(self):
+        roidb = SyntheticDataset(num_images=2).roidb()
+        loader = DetectionLoader(
+            roidb, _loader_cfg(), batch_size=2, with_masks=True, prefetch=False
+        )
+        batch = next(iter(loader))
+        assert batch.gt_masks is not None
+        assert batch.gt_masks.shape[:2] == (2, 8)
+
+
+class TestVoc:
+    def _make_devkit(self, tmp_path):
+        devkit = tmp_path / "VOC2007"
+        (devkit / "ImageSets" / "Main").mkdir(parents=True)
+        (devkit / "Annotations").mkdir()
+        (devkit / "JPEGImages").mkdir()
+        (devkit / "ImageSets" / "Main" / "trainval.txt").write_text("000001\n")
+        (devkit / "Annotations" / "000001.xml").write_text(
+            textwrap.dedent(
+                """\
+                <annotation>
+                  <size><width>200</width><height>100</height><depth>3</depth></size>
+                  <object>
+                    <name>dog</name><difficult>0</difficult>
+                    <bndbox><xmin>11</xmin><ymin>21</ymin><xmax>61</xmax><ymax>81</ymax></bndbox>
+                  </object>
+                  <object>
+                    <name>person</name><difficult>1</difficult>
+                    <bndbox><xmin>1</xmin><ymin>1</ymin><xmax>9</xmax><ymax>9</ymax></bndbox>
+                  </object>
+                </annotation>
+                """
+            )
+        )
+        return tmp_path
+
+    def test_parse(self, tmp_path):
+        ds = VocDataset(str(self._make_devkit(tmp_path)), "2007_trainval")
+        roidb = ds.roidb()
+        assert len(roidb) == 1
+        r = roidb[0]
+        assert (r.height, r.width) == (100, 200)
+        # difficult object skipped; VOC 1-based → 0-based
+        np.testing.assert_allclose(r.boxes, [[10, 20, 60, 80]])
+        assert ds.classes[r.gt_classes[0]] == "dog"
+
+
+class TestCoco:
+    def _make_coco(self, tmp_path):
+        ann_dir = tmp_path / "annotations"
+        ann_dir.mkdir()
+        d = {
+            "images": [{"id": 7, "file_name": "7.jpg", "height": 50, "width": 60}],
+            # Sparse category ids on purpose (COCO's 80-in-91 numbering).
+            "categories": [{"id": 3, "name": "car"}, {"id": 9, "name": "boat"}],
+            "annotations": [
+                {"id": 1, "image_id": 7, "category_id": 9, "bbox": [10, 10, 20, 20],
+                 "iscrowd": 0, "segmentation": [[10, 10, 30, 10, 30, 30]]},
+                {"id": 2, "image_id": 7, "category_id": 3, "bbox": [5, 5, 10, 10],
+                 "iscrowd": 1},
+            ],
+        }
+        (ann_dir / "instances_val.json").write_text(json.dumps(d))
+        return tmp_path
+
+    def test_index_and_mapping(self, tmp_path):
+        ds = CocoDataset(str(self._make_coco(tmp_path)), "val")
+        roidb = ds.roidb()
+        assert len(roidb) == 1
+        r = roidb[0]
+        assert len(r.boxes) == 1  # crowd skipped
+        np.testing.assert_allclose(r.boxes, [[10, 10, 29, 29]])
+        # Sparse id 9 → contiguous label 2 ("boat" after sorted ids [3, 9]).
+        assert r.gt_classes[0] == 2
+        assert ds.label_to_cat[2] == 9
+        assert r.masks is not None

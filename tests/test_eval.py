@@ -1,0 +1,157 @@
+"""Evaluator tests against hand-computed AP values."""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.evalutil import (
+    CocoEvaluator,
+    load_detections,
+    save_detections,
+    voc_ap,
+    voc_eval,
+)
+from mx_rcnn_tpu.evalutil.pred_eval import evaluate_detections
+from mx_rcnn_tpu.data.roidb import RoiRecord
+
+
+class TestVocAp:
+    def test_perfect_pr(self):
+        rec = np.array([0.5, 1.0])
+        prec = np.array([1.0, 1.0])
+        assert voc_ap(rec, prec) == pytest.approx(1.0)
+        assert voc_ap(rec, prec, use_07_metric=True) == pytest.approx(1.0)
+
+    def test_half_recall(self):
+        # One gt found perfectly, one never: AUC = 0.5.
+        rec = np.array([0.5])
+        prec = np.array([1.0])
+        assert voc_ap(rec, prec) == pytest.approx(0.5)
+
+
+class TestVocEval:
+    def _gt(self):
+        return {"img0": {"boxes": np.array([[0, 0, 10, 10], [50, 50, 70, 70]])}}
+
+    def test_perfect_detections(self):
+        dets = {
+            "img0": np.array(
+                [[0, 0, 10, 10, 0.9], [50, 50, 70, 70, 0.8]], float
+            )
+        }
+        ap, rec, prec = voc_eval(dets, self._gt())
+        assert ap == pytest.approx(1.0)
+        assert rec[-1] == pytest.approx(1.0)
+
+    def test_duplicate_is_fp(self):
+        dets = {
+            "img0": np.array(
+                [[0, 0, 10, 10, 0.9], [1, 1, 10, 10, 0.85], [50, 50, 70, 70, 0.8]],
+                float,
+            )
+        }
+        ap, rec, prec = voc_eval(dets, self._gt())
+        # Second hit on the same gt is a false positive: P at full recall 2/3.
+        assert rec[-1] == pytest.approx(1.0)
+        assert prec[-1] == pytest.approx(2 / 3)
+        assert ap == pytest.approx(0.5 + 0.5 * 2 / 3)
+
+    def test_miss_is_fp(self):
+        dets = {"img0": np.array([[100, 100, 120, 120, 0.9]], float)}
+        ap, _, _ = voc_eval(dets, self._gt())
+        assert ap == pytest.approx(0.0)
+
+    def test_difficult_ignored(self):
+        gt = {
+            "img0": {
+                "boxes": np.array([[0, 0, 10, 10], [50, 50, 70, 70]]),
+                "difficult": np.array([False, True]),
+            }
+        }
+        dets = {"img0": np.array([[0, 0, 10, 10, 0.9], [50, 50, 70, 70, 0.8]], float)}
+        ap, rec, _ = voc_eval(dets, gt)
+        # Difficult gt: its detection neither helps nor hurts; 1 real gt found.
+        assert ap == pytest.approx(1.0)
+
+
+class TestCocoEvaluator:
+    def test_perfect(self):
+        ev = CocoEvaluator(num_classes=3)
+        gt = np.array([[0, 0, 20, 20], [40, 40, 80, 90]], float)
+        ev.add_image("a", gt, np.array([0.9, 0.8]), np.array([1, 2]), gt, np.array([1, 2]))
+        s = ev.summarize()
+        assert s["AP"] == pytest.approx(1.0)
+        assert s["AP50"] == pytest.approx(1.0)
+        assert s["AR100"] == pytest.approx(1.0)
+
+    def test_loose_box_drops_high_iou_ap(self):
+        gt = np.array([[0, 0, 100, 100]], float)
+        det = np.array([[0, 0, 100, 80]], float)  # IoU 0.8
+        ev = CocoEvaluator(num_classes=2)
+        ev.add_image("a", det, np.array([0.9]), np.array([1]), gt, np.array([1]))
+        s = ev.summarize()
+        assert s["AP50"] == pytest.approx(1.0)
+        assert s["AP75"] == pytest.approx(1.0)
+        # Matched at 0.5..0.8 (7 of 10 thresholds) → AP = 0.7.
+        assert s["AP"] == pytest.approx(0.7)
+
+    def test_missed_gt_halves_recall(self):
+        gt = np.array([[0, 0, 20, 20], [50, 50, 80, 80]], float)
+        det = np.array([[0, 0, 20, 20]], float)
+        ev = CocoEvaluator(num_classes=2)
+        ev.add_image("a", det, np.array([0.9]), np.array([1]), gt, np.array([1, 1]))
+        s = ev.summarize()
+        assert s["AR100"] == pytest.approx(0.5)
+        # Precision 1 up to recall 0.5, 0 after → 101-pt AP ≈ 0.5
+        assert 0.45 <= s["AP"] <= 0.55
+
+    def test_area_buckets(self):
+        small_gt = np.array([[0, 0, 10, 10]], float)       # area 100 < 32²
+        large_gt = np.array([[0, 0, 200, 200]], float)     # area 4e4 > 96²
+        ev = CocoEvaluator(num_classes=2)
+        ev.add_image(
+            "a",
+            np.concatenate([small_gt, large_gt]),
+            np.array([0.9, 0.8]),
+            np.array([1, 1]),
+            np.concatenate([small_gt, large_gt]),
+            np.array([1, 1]),
+        )
+        s = ev.summarize()
+        assert s["APs"] == pytest.approx(1.0)
+        assert s["APl"] == pytest.approx(1.0)
+        assert s["APm"] == -1.0  # no medium gt anywhere
+
+    def test_score_ordering_matters(self):
+        # Wrong box scored higher than right box: FP before TP caps precision.
+        gt = np.array([[0, 0, 20, 20]], float)
+        dets = np.array([[100, 100, 120, 120], [0, 0, 20, 20]], float)
+        ev = CocoEvaluator(num_classes=2)
+        ev.add_image("a", dets, np.array([0.9, 0.8]), np.array([1, 1]), gt, np.array([1]))
+        s = ev.summarize()
+        assert s["AP"] == pytest.approx(0.5, abs=0.01)
+
+
+class TestDetectionCache:
+    def test_roundtrip_and_reeval(self, tmp_path):
+        gt_box = np.array([[0, 0, 20, 20]], np.float32)
+        per_image = {
+            "7": {
+                "boxes": gt_box,
+                "scores": np.array([0.95], np.float32),
+                "classes": np.array([1], np.int32),
+            }
+        }
+        p = str(tmp_path / "dets.json")
+        save_detections(p, per_image)
+        loaded = load_detections(p)
+        np.testing.assert_allclose(loaded["7"]["boxes"], gt_box)
+        roidb = [
+            RoiRecord("7", "", 100, 100, gt_box, np.array([1], np.int32))
+        ]
+        # reeval parity: score cached detections without a model.
+        res = evaluate_detections(loaded, roidb, num_classes=2, style="coco")
+        assert res["AP"] == pytest.approx(1.0)
+        res_voc = evaluate_detections(
+            loaded, roidb, num_classes=2, style="voc", class_names=("bg", "obj")
+        )
+        assert res_voc["mAP"] == pytest.approx(1.0)
