@@ -1,0 +1,23 @@
+"""Command-line drivers (the reference's L7 layer, SURVEY.md §3.1).
+
+One module per driver, mirroring the reference's entry points:
+
+=======================  ==========================================
+reference                here
+=======================  ==========================================
+``train_end2end.py``     :mod:`mx_rcnn_tpu.cli.train_cli`
+``train_alternate.py``   :mod:`mx_rcnn_tpu.cli.alternate_cli`
+``test.py``              :mod:`mx_rcnn_tpu.cli.eval_cli`
+``demo.py``              :mod:`mx_rcnn_tpu.cli.demo_cli`
+``rcnn/tools/reeval.py`` :mod:`mx_rcnn_tpu.cli.reeval_cli`
+``rcnn/tools/test_rpn``  ``eval_cli --proposals`` (proposal dump)
+=======================  ==========================================
+
+Thin repo-root scripts (``train.py``, ``test.py``, ``demo.py``,
+``train_alternate.py``, ``reeval.py``) call these mains, so the user-facing
+commands match the reference verbatim.
+"""
+
+from mx_rcnn_tpu.cli.common import config_from_args, setup_logging
+
+__all__ = ["config_from_args", "setup_logging"]

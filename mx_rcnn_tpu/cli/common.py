@@ -1,0 +1,55 @@
+"""Shared argparse plumbing for all drivers.
+
+Replaces the reference's per-driver ``parse_args`` + ``generate_config``
+pattern (``train_end2end.py::parse_args`` mutating ``rcnn/config.py``'s
+global): every driver here takes ``--config <preset>`` plus dotted
+``--set section.field=value`` overrides and gets back one frozen Config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from mx_rcnn_tpu.config import Config, apply_overrides, available_configs, get_config
+
+
+def setup_logging(verbose: bool = False) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+        force=True,
+    )
+
+
+def add_config_args(p: argparse.ArgumentParser, default: str = "r50_fpn_coco") -> None:
+    p.add_argument(
+        "--config",
+        default=default,
+        choices=available_configs(),
+        help="experiment preset (reference: --network + --dataset pair)",
+    )
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        action="append",
+        default=[],
+        metavar="KEY.PATH=VALUE",
+        help="dotted config override, e.g. --set data.root=/data/coco "
+        "--set train.schedule.total_steps=1000 (repeatable)",
+    )
+    p.add_argument("--workdir", default=None, help="run directory (ckpts, dumps)")
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = get_config(args.config)
+    if args.overrides:
+        cfg = apply_overrides(cfg, args.overrides)
+    if getattr(args, "workdir", None):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, workdir=args.workdir)
+    return cfg

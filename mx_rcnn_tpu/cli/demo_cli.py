@@ -1,0 +1,151 @@
+"""Single-image demo: checkpoint → detections → visualization.
+
+Parity with ``demo.py`` (SURVEY.md §4.4): load an image, run the jitted
+inference graph, print detections, draw labeled boxes to an output file
+(``rcnn/core/tester.py::vis_all_detection`` equivalent, headless).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import numpy as np
+
+from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
+from mx_rcnn_tpu.config import Config
+
+log = logging.getLogger("mx_rcnn_tpu.demo")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_config_args(p)
+    p.add_argument("image", help="input image path")
+    p.add_argument("--ckpt", default=None, help="checkpoint dir (default: workdir)")
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--out", default=None, help="output visualization path (png)")
+    p.add_argument("--threshold", type=float, default=0.5, help="vis score cutoff")
+    p.add_argument(
+        "--random-params", action="store_true",
+        help="skip checkpoint load (smoke-test the graph with random weights)",
+    )
+    return p.parse_args(argv)
+
+
+def detect_image(cfg: Config, variables, image: np.ndarray):
+    """Run inference on one RGB uint8/float image; detections in original
+    image coordinates (the reference's ``im_detect`` + unscale)."""
+    import jax
+
+    from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
+    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_inference
+
+    model = TwoStageDetector(cfg=cfg.model)
+    h, w = image.shape[:2]
+    canvas, _, scale, (nh, nw) = letterbox(
+        image.astype(np.float32),
+        np.zeros((0, 4), np.float32),
+        cfg.data.image_size,
+        cfg.data.short_side,
+        cfg.data.max_side,
+    )
+    canvas = normalize_image(canvas, cfg.data.pixel_mean, cfg.data.pixel_std)
+    g = cfg.data.max_gt_boxes
+    batch = Batch(
+        images=canvas[None],
+        image_hw=np.array([[nh, nw]], np.float32),
+        gt_boxes=np.zeros((1, g, 4), np.float32),
+        gt_classes=np.zeros((1, g), np.int32),
+        gt_valid=np.zeros((1, g), bool),
+    )
+    infer = jax.jit(lambda v, b: forward_inference(model, v, b))
+    dets = jax.device_get(infer(variables, batch))
+    valid = np.asarray(dets.valid[0])
+    boxes = np.asarray(dets.boxes[0])[valid] / scale
+    boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, w - 1)
+    boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, h - 1)
+    return boxes, np.asarray(dets.scores[0])[valid], np.asarray(dets.classes[0])[valid]
+
+
+def draw_detections(
+    image: np.ndarray,
+    boxes: np.ndarray,
+    scores: np.ndarray,
+    classes: np.ndarray,
+    class_names,
+    out_path: str,
+    threshold: float = 0.5,
+) -> int:
+    """Matplotlib box overlay (vis_all_detection parity, saved not shown)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(1, figsize=(12, 12 * image.shape[0] / max(image.shape[1], 1)))
+    ax.imshow(image.astype(np.uint8))
+    ax.axis("off")
+    cmap = plt.get_cmap("hsv")
+    shown = 0
+    for box, score, cls in zip(boxes, scores, classes):
+        if score < threshold:
+            continue
+        color = cmap((int(cls) * 37 % 256) / 256.0)
+        x1, y1, x2, y2 = box
+        ax.add_patch(
+            plt.Rectangle((x1, y1), x2 - x1, y2 - y1, fill=False,
+                          edgecolor=color, linewidth=2)
+        )
+        name = class_names[int(cls)] if class_names else str(int(cls))
+        ax.text(x1, max(y1 - 3, 0), f"{name} {score:.2f}", fontsize=9,
+                color="white", bbox=dict(facecolor=color, alpha=0.7, pad=1))
+        shown += 1
+    fig.savefig(out_path, bbox_inches="tight", dpi=120)
+    plt.close(fig)
+    return shown
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    setup_logging(args.verbose)
+    cfg = config_from_args(args)
+
+    import jax
+
+    from mx_rcnn_tpu.parallel.step import eval_variables
+
+    from PIL import Image
+
+    image = np.asarray(Image.open(args.image).convert("RGB"))
+    if args.random_params:
+        from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+
+        variables = init_detector(
+            TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0), cfg.data.image_size
+        )
+    else:
+        from mx_rcnn_tpu.cli.eval_cli import _restored_state
+
+        variables = eval_variables(jax.device_get(_restored_state(cfg, args.ckpt, args.step)))
+
+    boxes, scores, classes = detect_image(cfg, variables, image)
+    class_names = None
+    if cfg.data.dataset == "voc":
+        from mx_rcnn_tpu.data.datasets import VOC_CLASSES
+
+        class_names = ("__background__",) + VOC_CLASSES
+    for box, score, cls in zip(boxes, scores, classes):
+        if score >= args.threshold:
+            name = class_names[int(cls)] if class_names else str(int(cls))
+            log.info("%s %.3f [%.1f %.1f %.1f %.1f]", name, score, *box)
+    out = args.out or (args.image.rsplit(".", 1)[0] + "_det.png")
+    n = draw_detections(
+        image, boxes, scores, classes, class_names, out, args.threshold
+    )
+    log.info("drew %d detections -> %s", n, out)
+    return boxes, scores, classes
+
+
+if __name__ == "__main__":
+    main()

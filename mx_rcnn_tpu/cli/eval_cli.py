@@ -1,0 +1,173 @@
+"""Evaluation driver.
+
+Parity with ``test.py`` → ``rcnn/core/tester.py::pred_eval`` (SURVEY.md
+§4.3): restore checkpoint, run the jitted inference graph over the val
+split, score with the dataset evaluator (COCO mAP@[.5:.95] or VOC AP).
+``--proposals`` runs the RPN-only path and dumps proposals instead
+(``rcnn/tools/test_rpn.py`` parity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+from typing import Optional
+
+from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
+from mx_rcnn_tpu.config import Config
+
+log = logging.getLogger("mx_rcnn_tpu.eval")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_config_args(p)
+    p.add_argument("--ckpt", default=None, help="checkpoint dir (default: workdir)")
+    p.add_argument("--step", type=int, default=None, help="checkpoint step")
+    p.add_argument(
+        "--dump", default=None, help="write raw detections here (reeval input)"
+    )
+    p.add_argument(
+        "--proposals",
+        default=None,
+        metavar="OUT.PKL",
+        help="dump RPN proposals per image instead of evaluating (test_rpn parity)",
+    )
+    p.add_argument(
+        "--use-07-metric", action="store_true", help="VOC 11-point AP metric"
+    )
+    return p.parse_args(argv)
+
+
+def _eval_loader(cfg: Config, with_masks: bool = False):
+    from mx_rcnn_tpu.data import DetectionLoader, build_dataset
+
+    roidb = build_dataset(cfg.data, train=False).roidb()
+    loader = DetectionLoader(
+        roidb, cfg.data, batch_size=1, train=False, with_masks=with_masks
+    )
+    return roidb, loader
+
+
+def _restored_state(cfg: Config, ckpt_dir: Optional[str], step: Optional[int]):
+    from mx_rcnn_tpu.train.checkpoint import restore_checkpoint
+    from mx_rcnn_tpu.train.loop import build_all
+
+    _, _, state, _, _ = build_all(cfg, mesh=None)
+    ckpt = ckpt_dir or f"{cfg.workdir}/{cfg.name}/ckpt"
+    return restore_checkpoint(ckpt, state, step=step)
+
+
+def run_eval(
+    cfg: Config,
+    state=None,
+    ckpt_dir: Optional[str] = None,
+    step: Optional[int] = None,
+    dump_path: Optional[str] = None,
+    use_07_metric: bool = False,
+) -> dict:
+    """Evaluate a state (or a restored checkpoint) on the config's val split."""
+    import jax
+
+    from mx_rcnn_tpu.detection import TwoStageDetector
+    from mx_rcnn_tpu.evalutil import pred_eval
+    from mx_rcnn_tpu.parallel.step import eval_variables, make_eval_step
+
+    if state is None:
+        state = _restored_state(cfg, ckpt_dir, step)
+    state = jax.device_get(state)
+    model = TwoStageDetector(cfg=cfg.model)
+    eval_step = make_eval_step(model)
+    roidb, loader = _eval_loader(cfg)
+    style = "voc" if cfg.data.dataset == "voc" else "coco"
+    class_names = None
+    if cfg.data.dataset == "voc":
+        from mx_rcnn_tpu.data.datasets import VOC_CLASSES
+
+        class_names = ("__background__",) + VOC_CLASSES
+    metrics = pred_eval(
+        eval_step,
+        eval_variables(state),
+        loader,
+        roidb,
+        cfg.model.num_classes,
+        style=style,
+        class_names=class_names,
+        use_07_metric=use_07_metric,
+        dump_path=dump_path,
+    )
+    for k, v in sorted(metrics.items()):
+        log.info("%s = %.4f", k, v)
+    return metrics
+
+
+def dump_proposals(
+    cfg: Config,
+    out_path: str,
+    state=None,
+    ckpt_dir: Optional[str] = None,
+    step: Optional[int] = None,
+    train_split: bool = True,
+) -> dict:
+    """Run the RPN over a split and dump per-image proposal boxes+scores.
+
+    The alternate-training bridge: phase N's RPN writes the proposal roidb
+    consumed by phase N+1's Fast R-CNN training (SURVEY.md §4.2 steps 2/5).
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from mx_rcnn_tpu.data import DetectionLoader, build_dataset
+    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_proposals
+    from mx_rcnn_tpu.parallel.step import eval_variables
+
+    if state is None:
+        state = _restored_state(cfg, ckpt_dir, step)
+    state = jax.device_get(state)
+    model = TwoStageDetector(cfg=cfg.model)
+    variables = eval_variables(state)
+    prop_step = jax.jit(lambda v, b: forward_proposals(model, v, b))
+
+    data_cfg = cfg.data
+    split = data_cfg.train_split if train_split else data_cfg.val_split
+    roidb = build_dataset(dataclasses.replace(data_cfg, val_split=split), train=False).roidb()
+    loader = DetectionLoader(roidb, data_cfg, batch_size=1, train=False)
+    out: dict[str, dict] = {}
+    for batch, recs in loader:
+        props = jax.device_get(prop_step(variables, batch))
+        for i, rec in enumerate(recs):
+            scale = loader.record_scale(rec)
+            valid = np.asarray(props.valid[i])
+            out[rec.image_id] = {
+                "boxes": np.asarray(props.rois[i])[valid] / scale,
+                "scores": np.asarray(props.scores[i])[valid],
+            }
+    with open(out_path, "wb") as f:
+        pickle.dump(out, f)
+    log.info("wrote %d images' proposals to %s", len(out), out_path)
+    return out
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    setup_logging(args.verbose)
+    cfg = config_from_args(args)
+    if args.proposals:
+        return dump_proposals(
+            cfg, args.proposals, ckpt_dir=args.ckpt, step=args.step,
+            train_split=False,
+        )
+    return run_eval(
+        cfg,
+        ckpt_dir=args.ckpt,
+        step=args.step,
+        dump_path=args.dump,
+        use_07_metric=args.use_07_metric,
+    )
+
+
+if __name__ == "__main__":
+    main()

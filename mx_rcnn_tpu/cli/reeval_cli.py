@@ -1,0 +1,52 @@
+"""Re-score cached detections without a model.
+
+Parity with ``rcnn/tools/reeval.py``: load a detection dump written by
+``eval_cli --dump``, re-run the dataset evaluator.  Useful for trying eval
+variants (07-metric vs area AP) without re-running inference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
+
+log = logging.getLogger("mx_rcnn_tpu.reeval")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_config_args(p)
+    p.add_argument("detections", help="dump file from eval_cli --dump")
+    p.add_argument("--use-07-metric", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    setup_logging(args.verbose)
+    cfg = config_from_args(args)
+
+    from mx_rcnn_tpu.data import build_dataset
+    from mx_rcnn_tpu.evalutil import evaluate_detections, load_detections
+
+    per_image = load_detections(args.detections)
+    roidb = build_dataset(cfg.data, train=False).roidb()
+    style = "voc" if cfg.data.dataset == "voc" else "coco"
+    class_names = None
+    if cfg.data.dataset == "voc":
+        from mx_rcnn_tpu.data.datasets import VOC_CLASSES
+
+        class_names = ("__background__",) + VOC_CLASSES
+    metrics = evaluate_detections(
+        per_image, roidb, cfg.model.num_classes, style, class_names,
+        use_07_metric=args.use_07_metric,
+    )
+    for k, v in sorted(metrics.items()):
+        log.info("%s = %.4f", k, v)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""End-to-end training driver.
+
+Parity with ``train_end2end.py`` (SURVEY.md §3.1/§4.1): config + overrides →
+mesh → train loop with metrics/checkpoints, optional resume, optional final
+evaluation pass.  The kvstore/ctx-list plumbing of the reference is replaced
+by the device mesh (all visible chips by default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
+
+log = logging.getLogger("mx_rcnn_tpu.train")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_config_args(p)
+    p.add_argument("--resume", action="store_true", help="resume from workdir ckpt")
+    p.add_argument(
+        "--steps", type=int, default=None, help="override schedule total_steps"
+    )
+    p.add_argument(
+        "--no-eval", action="store_true", help="skip the final evaluation pass"
+    )
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    setup_logging(args.verbose)
+    cfg = config_from_args(args)
+
+    import jax
+
+    from mx_rcnn_tpu.parallel import make_mesh
+    from mx_rcnn_tpu.train.loop import train
+
+    mesh = make_mesh() if jax.device_count() > 1 else None
+    n_dev = mesh.size if mesh is not None else 1
+    log.info(
+        "config=%s devices=%d backend=%s", cfg.name, n_dev, jax.default_backend()
+    )
+    state = train(
+        cfg,
+        mesh=mesh,
+        total_steps=args.steps,
+        workdir=cfg.workdir,
+        resume=args.resume,
+    )
+    metrics: dict = {"final_step": int(jax.device_get(state.step))}
+    if not args.no_eval:
+        from mx_rcnn_tpu.cli.eval_cli import run_eval
+
+        metrics.update(run_eval(cfg, state=state))
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

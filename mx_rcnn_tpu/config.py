@@ -344,3 +344,48 @@ def get_config(name: str, **overrides: Any) -> Config:
         raise KeyError(f"unknown config {name!r}; available: {available_configs()}")
     cfg = _PRESETS[name]()
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def _coerce(text: str, current: Any) -> Any:
+    """Parse ``text`` to the type of ``current`` (the existing field value)."""
+    if isinstance(current, bool):
+        if text.lower() in ("1", "true", "yes"):
+            return True
+        if text.lower() in ("0", "false", "no"):
+            return False
+        raise ValueError(f"expected bool, got {text!r}")
+    if isinstance(current, tuple):
+        parts = [p for p in text.replace("(", "").replace(")", "").split(",") if p]
+        elem = current[0] if current else float("nan")
+        return tuple(type(elem)(p) if current else float(p) for p in parts)
+    if isinstance(current, int) and not isinstance(current, bool):
+        return int(text)
+    if isinstance(current, float):
+        return float(text)
+    return text
+
+
+def apply_overrides(cfg: Config, assignments: list[str]) -> Config:
+    """Apply CLI ``dotted.path=value`` overrides to a frozen config tree.
+
+    The functional replacement for the reference CLIs' ad-hoc mutation of the
+    global easydict (e.g. ``config.TRAIN.BATCH_IMAGES = args.batch``): each
+    assignment rebuilds the dataclass spine from the leaf up.
+    """
+    for item in assignments:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not of the form key.path=value")
+        path, text = item.split("=", 1)
+        keys = path.strip().split(".")
+        # Collect the chain of dataclass nodes down to the leaf's parent.
+        nodes = [cfg]
+        for k in keys[:-1]:
+            nodes.append(getattr(nodes[-1], k))
+        leaf = getattr(nodes[-1], keys[-1])
+        if dataclasses.is_dataclass(leaf):
+            raise ValueError(f"{path} is a config section, not a field")
+        new_val = _coerce(text.strip(), leaf)
+        for node, k in zip(reversed(nodes), reversed(keys)):
+            new_val = dataclasses.replace(node, **{k: new_val})
+        cfg = new_val
+    return cfg

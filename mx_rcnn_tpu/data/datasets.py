@@ -88,6 +88,17 @@ class SyntheticDataset:
         h, w = self.image_hw
         for i in range(self.num_images):
             img, boxes, classes = self._render(i)
+            # Instance masks: an octagon inset in each box (mask != box, so
+            # mask-head tests get real signal, COCO polygon format).
+            masks = []
+            for (x1, y1, x2, y2) in boxes:
+                bw, bh = x2 - x1, y2 - y1
+                cx, cy = (x1 + x2) / 2, (y1 + y2) / 2
+                poly = []
+                for dx, dy in ((-.5, -.25), (-.25, -.5), (.25, -.5), (.5, -.25),
+                               (.5, .25), (.25, .5), (-.25, .5), (-.5, .25)):
+                    poly += [cx + dx * bw, cy + dy * bh]
+                masks.append([poly])
             out.append(
                 RoiRecord(
                     image_id=str(i),
@@ -96,6 +107,7 @@ class SyntheticDataset:
                     width=w,
                     boxes=boxes,
                     gt_classes=classes,
+                    masks=masks,
                     image_array=img,
                 )
             )

@@ -4,6 +4,7 @@ from mx_rcnn_tpu.detection.graph import (
     Detections,
     forward_train,
     forward_inference,
+    forward_proposals,
     init_detector,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "Detections",
     "forward_train",
     "forward_inference",
+    "forward_proposals",
     "init_detector",
 ]

@@ -10,11 +10,17 @@ detections).
 
 from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
 from mx_rcnn_tpu.evalutil.detections import load_detections, save_detections
-from mx_rcnn_tpu.evalutil.pred_eval import pred_eval
+from mx_rcnn_tpu.evalutil.pred_eval import (
+    collect_detections,
+    evaluate_detections,
+    pred_eval,
+)
 from mx_rcnn_tpu.evalutil.voc_eval import voc_ap, voc_eval
 
 __all__ = [
     "CocoEvaluator",
+    "collect_detections",
+    "evaluate_detections",
     "load_detections",
     "pred_eval",
     "save_detections",

@@ -49,8 +49,11 @@ class CocoEvaluator:
     per-category AP.  Labels are contiguous 1-based category indices.
     """
 
-    def __init__(self, num_classes: int) -> None:
+    def __init__(self, num_classes: int, iou_type: str = "bbox") -> None:
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"iou_type must be bbox|segm, got {iou_type!r}")
         self.num_classes = num_classes  # incl. background 0
+        self.iou_type = iou_type
         # (cat, image) → dict(dt=..., gt=..., iou=...)
         self._dts: dict = defaultdict(list)
         self._gts: dict = defaultdict(list)
@@ -64,19 +67,28 @@ class CocoEvaluator:
         det_classes: np.ndarray,  # (n,) 1-based
         gt_boxes: np.ndarray,     # (m, 4)
         gt_classes: np.ndarray,   # (m,)
+        det_masks: list | None = None,  # n RLE dicts (segm mode)
+        gt_masks: list | None = None,   # m RLE dicts (segm mode)
     ) -> None:
         self._images.add(image_id)
         det_boxes = np.asarray(det_boxes, float).reshape(-1, 4)
         gt_boxes = np.asarray(gt_boxes, float).reshape(-1, 4)
+        if self.iou_type == "segm" and (det_masks is None or gt_masks is None):
+            raise ValueError("segm evaluation needs det_masks and gt_masks RLEs")
         for c in range(1, self.num_classes):
-            dm = np.asarray(det_classes) == c
-            gm = np.asarray(gt_classes) == c
-            if dm.any():
+            dm = np.flatnonzero(np.asarray(det_classes) == c)
+            gm = np.flatnonzero(np.asarray(gt_classes) == c)
+            if dm.size:
                 self._dts[(c, image_id)] = (
-                    det_boxes[dm], np.asarray(det_scores, float)[dm]
+                    det_boxes[dm],
+                    np.asarray(det_scores, float)[dm],
+                    [det_masks[i] for i in dm] if det_masks is not None else None,
                 )
-            if gm.any():
-                self._gts[(c, image_id)] = gt_boxes[gm]
+            if gm.size:
+                self._gts[(c, image_id)] = (
+                    gt_boxes[gm],
+                    [gt_masks[i] for i in gm] if gt_masks is not None else None,
+                )
 
     # -- matching ----------------------------------------------------------
 
@@ -88,19 +100,33 @@ class CocoEvaluator:
         if dt is None:
             dboxes = np.zeros((0, 4))
             dscores = np.zeros(0)
+            dmasks = []
         else:
-            dboxes, dscores = dt
+            dboxes, dscores, dmasks = dt
             order = np.argsort(-dscores, kind="mergesort")[:max_det]
             dboxes, dscores = dboxes[order], dscores[order]
-        gboxes = gt if gt is not None else np.zeros((0, 4))
+            dmasks = [dmasks[i] for i in order] if dmasks is not None else []
+        gboxes, gmasks = gt if gt is not None else (np.zeros((0, 4)), [])
 
-        garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+        if self.iou_type == "segm":
+            from mx_rcnn_tpu.evalutil.masks import rle_area
+
+            garea = np.asarray([rle_area(m) for m in (gmasks or [])], float)
+            garea = garea.reshape(len(gboxes))
+        else:
+            garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
         g_ignore = (garea < area_rng[0]) | (garea > area_rng[1])
         # Sort gt: non-ignored first (COCO matches real gt preferentially).
         g_order = np.argsort(g_ignore, kind="mergesort")
         gboxes, g_ignore = gboxes[g_order], g_ignore[g_order]
 
-        ious = _xyxy_iou(dboxes, gboxes)
+        if self.iou_type == "segm":
+            from mx_rcnn_tpu.evalutil.masks import rle_iou
+
+            gmasks = [gmasks[i] for i in g_order] if gmasks else []
+            ious = rle_iou(dmasks, gmasks)
+        else:
+            ious = _xyxy_iou(dboxes, gboxes)
         T, D, G = len(IOU_THRS), len(dboxes), len(gboxes)
         dt_match = np.zeros((T, D), dtype=np.int64)  # 1 + matched gt idx, 0 = none
         gt_match = np.zeros((T, G), dtype=np.int64)
@@ -119,7 +145,14 @@ class CocoEvaluator:
                 if best_j > -1:
                     dt_match[ti, di] = best_j + 1
                     gt_match[ti, best_j] = di + 1
-        darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+        if self.iou_type == "segm":
+            from mx_rcnn_tpu.evalutil.masks import rle_area
+
+            darea = np.asarray([rle_area(m) for m in dmasks], float).reshape(
+                len(dboxes)
+            )
+        else:
+            darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
         # Unmatched dets outside the area range are ignored, matched-to-
         # ignored-gt dets are ignored.
         dt_ignore = np.zeros((T, D), bool)

@@ -14,15 +14,21 @@ import numpy as np
 
 
 def save_detections(path: str, per_image: dict[str, dict]) -> None:
-    """per_image: image_id → {"boxes": (n,4), "scores": (n,), "classes": (n,)}."""
-    ser = {
-        k: {
+    """per_image: image_id → {"boxes": (n,4), "scores": (n,), "classes": (n,)}
+    plus optional "masks": list of RLE dicts (instance segmentation)."""
+    ser = {}
+    for k, v in per_image.items():
+        entry = {
             "boxes": np.asarray(v["boxes"], float).reshape(-1, 4).tolist(),
             "scores": np.asarray(v["scores"], float).reshape(-1).tolist(),
             "classes": np.asarray(v["classes"], int).reshape(-1).tolist(),
         }
-        for k, v in per_image.items()
-    }
+        if "masks" in v:
+            entry["masks"] = [
+                {"size": list(m["size"]), "counts": np.asarray(m["counts"]).tolist()}
+                for m in v["masks"]
+            ]
+        ser[k] = entry
     with open(path, "w") as f:
         json.dump(ser, f)
 
@@ -30,11 +36,17 @@ def save_detections(path: str, per_image: dict[str, dict]) -> None:
 def load_detections(path: str) -> dict[str, dict]:
     with open(path) as f:
         raw = json.load(f)
-    return {
-        k: {
+    out = {}
+    for k, v in raw.items():
+        entry = {
             "boxes": np.asarray(v["boxes"], np.float32).reshape(-1, 4),
             "scores": np.asarray(v["scores"], np.float32).reshape(-1),
             "classes": np.asarray(v["classes"], np.int32).reshape(-1),
         }
-        for k, v in raw.items()
-    }
+        if "masks" in v:
+            entry["masks"] = [
+                {"size": tuple(m["size"]), "counts": np.asarray(m["counts"], np.uint32)}
+                for m in v["masks"]
+            ]
+        out[k] = entry
+    return out

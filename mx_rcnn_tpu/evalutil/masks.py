@@ -1,0 +1,160 @@
+"""Host-side instance-mask utilities: paste-back, RLE codec, mask IoU.
+
+The functionality of the reference's vendored COCO mask C library
+(``rcnn/pycocotools/maskApi.c``: rleEncode/rleDecode/rleArea/rleIou —
+SURVEY.md §3.5) reimplemented from the RLE definition.  The numpy versions
+here are the reference implementation; the C++ extension
+(:mod:`mx_rcnn_tpu.native`) accelerates the same contract when built.
+
+RLE format: column-major (Fortran order, matching COCO) run lengths of
+alternating 0/1 runs, starting with 0: {"size": (h, w), "counts": uint32[]}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import cv2
+except Exception:  # pragma: no cover
+    cv2 = None
+
+
+def paste_mask(
+    mask: np.ndarray, box: np.ndarray, height: int, width: int,
+    threshold: float = 0.5,
+) -> np.ndarray:
+    """(M, M) probability mask + xyxy box → (height, width) bool canvas.
+
+    The inverse of the mask head's box-relative crop (the reference-era
+    equivalent lives in Mask R-CNN's ``paste_mask_in_image``): resize the
+    M×M grid to the box extent, threshold, paste clipped to the canvas.
+    """
+    x1, y1, x2, y2 = box
+    x1i = int(np.floor(x1))
+    y1i = int(np.floor(y1))
+    x2i = int(np.ceil(x2)) + 1
+    y2i = int(np.ceil(y2)) + 1
+    bw = max(x2i - x1i, 1)
+    bh = max(y2i - y1i, 1)
+    if cv2 is not None:
+        up = cv2.resize(mask.astype(np.float32), (bw, bh))
+    else:  # pragma: no cover
+        yi = np.clip(
+            np.floor(np.arange(bh) / bh * mask.shape[0]).astype(int), 0,
+            mask.shape[0] - 1,
+        )
+        xi = np.clip(
+            np.floor(np.arange(bw) / bw * mask.shape[1]).astype(int), 0,
+            mask.shape[1] - 1,
+        )
+        up = mask[yi][:, xi]
+    out = np.zeros((height, width), bool)
+    ys, xs = max(y1i, 0), max(x1i, 0)
+    ye, xe = min(y2i, height), min(x2i, width)
+    if ye > ys and xe > xs:
+        out[ys:ye, xs:xe] = up[ys - y1i : ye - y1i, xs - x1i : xe - x1i] >= threshold
+    return out
+
+
+def rle_encode(binary: np.ndarray) -> dict:
+    """(h, w) bool → COCO-style column-major RLE."""
+    h, w = binary.shape
+    flat = np.asarray(binary, np.uint8).T.reshape(-1)  # Fortran order
+    # Run-length: indices where the value changes.
+    change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    bounds = np.concatenate([[0], change, [flat.size]])
+    counts = np.diff(bounds).astype(np.uint32)
+    if flat.size and flat[0] == 1:  # first run must encode zeros
+        counts = np.concatenate([[np.uint32(0)], counts])
+    return {"size": (h, w), "counts": counts}
+
+
+def rle_decode(rle: dict) -> np.ndarray:
+    h, w = rle["size"]
+    counts = np.asarray(rle["counts"], np.int64)
+    vals = np.zeros(len(counts), np.uint8)
+    vals[1::2] = 1
+    flat = np.repeat(vals, counts)
+    if flat.size < h * w:
+        flat = np.concatenate([flat, np.zeros(h * w - flat.size, np.uint8)])
+    return flat.reshape(w, h).T.astype(bool)
+
+
+def rle_area(rle: dict) -> int:
+    return int(np.asarray(rle["counts"][1::2], np.int64).sum())
+
+
+def _intersection(a: dict, b: dict) -> int:
+    """Run-intersection of two RLEs without decoding (maskApi rleIou core)."""
+    ca = np.asarray(a["counts"], np.int64)
+    cb = np.asarray(b["counts"], np.int64)
+    ea = np.cumsum(ca)  # run end positions
+    eb = np.cumsum(cb)
+    # Merge run boundaries; count overlap where both runs are 1-runs.
+    inter = 0
+    ia = ib = 0
+    pos = 0
+    na, nb = len(ea), len(eb)
+    while ia < na and ib < nb:
+        end = min(ea[ia], eb[ib])
+        if ia % 2 == 1 and ib % 2 == 1:
+            inter += end - pos
+        pos = end
+        if ea[ia] == end:
+            ia += 1
+        if eb[ib] == end:
+            ib += 1
+    return int(inter)
+
+
+def rle_iou(dts: list[dict], gts: list[dict]) -> np.ndarray:
+    """(n dts) x (m gts) mask IoU matrix."""
+    n, m = len(dts), len(gts)
+    out = np.zeros((n, m))
+    d_areas = [rle_area(d) for d in dts]
+    g_areas = [rle_area(g) for g in gts]
+    for i in range(n):
+        for j in range(m):
+            inter = _intersection(dts[i], gts[j])
+            union = d_areas[i] + g_areas[j] - inter
+            out[i, j] = inter / union if union > 0 else 0.0
+    return out
+
+
+def rasterize_polygons(polys, height: int, width: int) -> np.ndarray:
+    """COCO polygon list (image coords) → (h, w) bool mask."""
+    out = np.zeros((height, width), np.uint8)
+    if cv2 is None or polys is None:  # pragma: no cover
+        return out.astype(bool)
+    pts = [
+        np.asarray(p, np.float32).reshape(-1, 2).round().astype(np.int32)
+        for p in polys
+    ]
+    cv2.fillPoly(out, pts, 1)
+    return out.astype(bool)
+
+
+def gt_record_rles(rec) -> list:
+    """Per-instance RLEs for a RoiRecord's gt masks (polygon / RLE dict /
+    missing → full-box rectangle fallback)."""
+    out = []
+    n = len(rec.boxes)
+    for i in range(n):
+        seg = rec.masks[i] if rec.masks is not None and i < len(rec.masks) else None
+        if isinstance(seg, list):
+            out.append(rle_encode(rasterize_polygons(seg, rec.height, rec.width)))
+        elif isinstance(seg, dict):
+            counts = seg["counts"]
+            if isinstance(counts, list):
+                out.append(
+                    {"size": tuple(seg["size"]), "counts": np.asarray(counts, np.uint32)}
+                )
+            else:
+                out.append(rle_encode(rle_decode(seg)))
+        else:
+            canvas = np.zeros((rec.height, rec.width), bool)
+            x1, y1, x2, y2 = np.asarray(rec.boxes[i], int)
+            canvas[max(y1, 0) : y2 + 1, max(x1, 0) : x2 + 1] = True
+            out.append(rle_encode(canvas))
+    return out

@@ -38,11 +38,20 @@ def collect_detections(
             # Clip to original extents (letterbox canvas may exceed them).
             boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, rec.width - 1)
             boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, rec.height - 1)
-            out[rec.image_id] = {
+            result = {
                 "boxes": boxes,
                 "scores": np.asarray(dets.scores[i])[valid],
                 "classes": np.asarray(dets.classes[i])[valid],
             }
+            if dets.masks is not None:
+                from mx_rcnn_tpu.evalutil.masks import paste_mask, rle_encode
+
+                probs = np.asarray(dets.masks[i])[valid]
+                result["masks"] = [
+                    rle_encode(paste_mask(m, b, rec.height, rec.width))
+                    for m, b in zip(probs, boxes)
+                ]
+            out[rec.image_id] = result
             done += 1
             if progress:
                 progress(done)
@@ -61,6 +70,10 @@ def evaluate_detections(
     loaded detections with no model)."""
     if style == "coco":
         ev = CocoEvaluator(num_classes)
+        have_masks = any("masks" in d for d in per_image.values())
+        seg_ev = CocoEvaluator(num_classes, iou_type="segm") if have_masks else None
+        if seg_ev is not None:
+            from mx_rcnn_tpu.evalutil.masks import gt_record_rles
         for rec in roidb:
             d = per_image.get(
                 rec.image_id,
@@ -70,7 +83,26 @@ def evaluate_detections(
                 rec.image_id, d["boxes"], d["scores"], d["classes"],
                 rec.boxes, rec.gt_classes,
             )
-        return ev.summarize()
+            if seg_ev is not None:
+                # An image entry without masks (e.g. merged dumps) contributes
+                # its gt as misses rather than crashing on mask lookup.
+                has_m = "masks" in d
+                z = np.zeros(0)
+                seg_ev.add_image(
+                    rec.image_id,
+                    d["boxes"] if has_m else np.zeros((0, 4)),
+                    d["scores"] if has_m else z,
+                    d["classes"] if has_m else z,
+                    rec.boxes, rec.gt_classes,
+                    det_masks=d.get("masks", []),
+                    gt_masks=gt_record_rles(rec),
+                )
+        metrics = ev.summarize()
+        if seg_ev is not None:
+            metrics.update(
+                {f"segm/{k}": v for k, v in seg_ev.summarize().items()}
+            )
+        return metrics
     if style == "voc":
         all_dets: dict[int, dict] = {c: {} for c in range(1, num_classes)}
         all_gt: dict[int, dict] = {c: {} for c in range(1, num_classes)}

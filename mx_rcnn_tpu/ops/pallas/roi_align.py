@@ -1,0 +1,283 @@
+"""Pallas TPU ROIAlign over an FPN pyramid.
+
+The TPU-native replacement for the reference's engine-side ROIPooling CUDA
+kernel (``mx.symbol.ROIPooling``; SURVEY.md §3.5 "engine-side native ops"),
+upgraded to ROIAlign.  The XLA fallback (:mod:`mx_rcnn_tpu.ops.roi_align`)
+pools every roi from every pyramid level and masks (4x redundant compute,
+gather-bound); this kernel does one pass:
+
+- grid = one step per roi;
+- the roi's assigned level (scalar-prefetched) selects which HBM feature
+  map a ``(T, T, C)`` window is DMA'd from — only the window travels over
+  HBM, never a whole pyramid level per roi;
+- bilinear interpolation is expressed as two small matmuls with sparse
+  interpolation matrices ``pooled = mean_pool(Wy @ window @ Wx^T)`` — the
+  MXU-friendly formulation of "gather 4 corners per sample" (each Wy/Wx row
+  holds the two bilinear taps of one sample coordinate);
+- bin-averaging folds into the same reshape.
+
+The window size T (default 40) bounds the roi extent in feature cells at
+its assigned level: :func:`fpn_level_assignment` is extent-aware (rois
+whose span would exceed T-2 cells are bumped to a coarser level), so the
+kernel is exact whenever the coarsest map fits the window — canvases up to
+(T-2) * 2^max_level px, i.e. 1216px at P5 with the default T.  Beyond
+that, samples past the window clamp to its edge (only for rois spanning
+more than T-2 cells at the coarsest level).
+
+Numerics match the XLA reference: samples outside (-1, H) x (-1, W)
+contribute zero; in-range samples clamp to the [0, H-1] cell range
+(Detectron ROIAlign semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mx_rcnn_tpu.ops.roi_align import fpn_level_assignment
+
+
+def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
+    """Rows = P = num_bins*sr sample coords; cols = T window cells.
+
+    Row p holds the two bilinear taps of sample p, zeroed when the sample
+    falls outside (-1, extent); both taps merge on the edge cell when the
+    sample clamps to extent-1 (weights sum to 1, matching the XLA path).
+    """
+    p = num_bins * sr
+    pid_i = jax.lax.broadcasted_iota(jnp.int32, (p, 1), 0)  # (P, 1)
+    s = (pid_i // sr).astype(jnp.float32)
+    frac = ((pid_i % sr).astype(jnp.float32) + 0.5) / sr
+    coord = start + (s + frac) * bin_size                    # absolute cells
+    inside = (coord > -1.0) & (coord < extent)
+    c = jnp.clip(coord, 0.0, extent - 1.0)
+    c0 = jnp.floor(c)
+    lc = c - c0
+    # Window-relative taps.  Negative is impossible (the origin sits one
+    # cell below the roi start); > t-1 only for rois spanning more than the
+    # window — those clamp to the window edge (see module docstring).
+    c0i = jnp.clip(c0.astype(jnp.int32) - origin, 0, t - 1)
+    c1i = jnp.clip(
+        jnp.minimum(c0i + 1, (extent - 1.0).astype(jnp.int32) - origin), 0, t - 1
+    )
+    cells = jax.lax.broadcasted_iota(jnp.int32, (p, t), 1)
+    w = jnp.where(cells == c0i, 1.0 - lc, 0.0) + jnp.where(cells == c1i, lc, 0.0)
+    return w * inside.astype(jnp.float32)                    # (P, T)
+
+
+def _kernel(
+    meta_ref,      # scalar prefetch: (R, 3) int32 [level_idx, oy, ox]
+    roi_ref,       # scalar prefetch: (R, 8) f32 [x1, y1, bin_w, bin_h, H, W, 0, 0]
+    *rest,
+    num_levels: int,
+    t: int,
+    output_size: int,
+    sampling_ratio: int,
+):
+    feat_refs = rest[:num_levels]
+    out_ref = rest[num_levels]
+    win = rest[num_levels + 1]
+    sem = rest[num_levels + 2]
+
+    r = pl.program_id(0)
+    level = meta_ref[r, 0]
+    oy = meta_ref[r, 1]
+    ox = pl.multiple_of(meta_ref[r, 2], 8)
+
+    # Window DMA from the assigned level.  Maps smaller than T copy their
+    # full extent into the top-left corner of the (zeroed) window.
+    for i, f in enumerate(feat_refs):
+        th = min(t, f.shape[0])
+        tw = min(t, f.shape[1])
+        if th < t or tw < t:
+            @pl.when(level == i)
+            def _():
+                win[:, :, :] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
+
+        @pl.when(level == i)
+        def _(f=f, th=th, tw=tw):
+            dma = pltpu.make_async_copy(
+                f.at[pl.ds(oy, th), pl.ds(ox, tw), :],
+                win.at[pl.ds(0, th), pl.ds(0, tw), :],
+                sem,
+            )
+            dma.start()
+            dma.wait()
+
+    x1 = roi_ref[r, 0]
+    y1 = roi_ref[r, 1]
+    bin_w = roi_ref[r, 2]
+    bin_h = roi_ref[r, 3]
+    hl = roi_ref[r, 4]
+    wl = roi_ref[r, 5]
+
+    s, sr = output_size, sampling_ratio
+    wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
+    wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)          # (Q=P, T)
+
+    c = win.shape[-1]
+    window = win[:, :, :].astype(jnp.float32)
+    # rows: (P, T) @ (T, T*C) -> (P, T, C)
+    # HIGHEST precision: the interpolation weights are exact f32; default
+    # (bf16 MXU passes) would quantize sample positions by ~2^-8.
+    rows = jax.lax.dot_general(
+        wy, window.reshape(t, t * c),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).reshape(s * sr, t, c)
+    # cols: contract the T (x) axis -> (Q, P, C)
+    qpc = jax.lax.dot_general(
+        wx, rows,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    # bin-average both sample axes, then swap (x-bins, y-bins) -> (y, x).
+    pooled = qpc.reshape(s, sr, s, sr, c).mean(axis=(1, 3))   # (Sx, Sy, C)
+    out_ref[0] = jnp.swapaxes(pooled, 0, 1).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("output_size", "sampling_ratio", "window", "interpret")
+)
+def multilevel_roi_align_pallas(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+    window: int = 48,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in replacement for :func:`multilevel_roi_align` (same contract:
+    pyramid {level: (H_l, W_l, C)}, rois (R, 4) image coords -> (R, S, S, C)).
+    """
+    levels = sorted(feature_pyramid.keys())
+    feats = [feature_pyramid[l] for l in levels]
+    n_rois = rois.shape[0]
+    c = feats[0].shape[-1]
+    t = window
+
+    assignment = fpn_level_assignment(
+        rois, min_level=levels[0], max_level=levels[-1],
+        max_extent_cells=window - 10,
+    )
+    level_idx = assignment - levels[0]                         # 0-based
+
+    # Per-roi geometry in its level's cell units (gather per-level consts).
+    scale = jnp.asarray([1.0 / (1 << l) for l in levels], jnp.float32)[level_idx]
+    hs = jnp.asarray([f.shape[0] for f in feats], jnp.float32)[level_idx]
+    ws = jnp.asarray([f.shape[1] for f in feats], jnp.float32)[level_idx]
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    rw = jnp.maximum(rois[:, 2] * scale - x1, 1.0)
+    rh = jnp.maximum(rois[:, 3] * scale - y1, 1.0)
+    roi_params = jnp.stack(
+        [x1, y1, rw / output_size, rh / output_size, hs, ws,
+         jnp.zeros_like(x1), jnp.zeros_like(x1)], axis=1,
+    ).astype(jnp.float32)                                      # (R, 8)
+
+    # Window origin: one cell of bilinear margin, clamped into the map.
+    # ox additionally floors to a multiple of 8 — Mosaic requires provable
+    # sublane alignment for HBM slices in the tiled (second-to-last) dim;
+    # the up-to-7-cell loss is budgeted in max_extent_cells below.
+    oy = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - t, 0)).astype(jnp.int32)
+    ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws - t, 0)).astype(jnp.int32)
+    ox = (ox // 8) * 8
+    meta = jnp.stack([level_idx, oy, ox], axis=1)              # (R, 3) int32
+
+    kernel = functools.partial(
+        _kernel,
+        num_levels=len(levels),
+        t=t,
+        output_size=output_size,
+        sampling_ratio=sampling_ratio,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_rois,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
+        out_specs=pl.BlockSpec(
+            (1, output_size, output_size, c),
+            lambda r, meta, roip: (r, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t, t, c), feats[0].dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_rois, output_size, output_size, c), feats[0].dtype
+        ),
+        interpret=interpret,
+    )(meta, roi_params, *feats)
+
+
+def pallas_supported(feature_pyramid: dict, window: int = 48) -> bool:
+    """Static check that every level's layout is Mosaic-DMA-sliceable:
+    the x (sublane-tiled) dim must be a multiple of 8 — the window copy
+    slices both the HBM source and the VMEM scratch along it — and
+    channels a multiple of 128 (lane dim).  Single-level (C4) pyramids use
+    the XLA path (their roi extent is unbounded by level reassignment)."""
+    for f in feature_pyramid.values():
+        w, c = f.shape[-2:]
+        if c % 128 != 0 or w % 8 != 0:
+            return False
+    return len(feature_pyramid) > 1
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(2, 3, 4)
+)
+def multilevel_roi_align_fast(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+    window: int = 48,
+) -> jnp.ndarray:
+    """Pallas forward + XLA-reference backward.
+
+    Forward runs the kernel above; the VJP differentiates the XLA
+    implementation of the same function (:func:`multilevel_roi_align` with
+    the matching extent-aware level assignment), which is exact because
+    both compute identical outputs.  Roi coordinates get no gradient (the
+    reference's Proposal/ProposalTarget custom ops are forward-only too —
+    SURVEY.md §4.1)."""
+    return multilevel_roi_align_pallas(
+        feature_pyramid, rois, output_size=output_size,
+        sampling_ratio=sampling_ratio, window=window,
+    )
+
+
+def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window):
+    out = multilevel_roi_align_fast(
+        feature_pyramid, rois, output_size, sampling_ratio, window
+    )
+    return out, (feature_pyramid, rois)
+
+
+def _fast_bwd(output_size, sampling_ratio, window, res, g):
+    from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
+
+    feature_pyramid, rois = res
+    _, vjp = jax.vjp(
+        lambda p: multilevel_roi_align(
+            p, rois, output_size=output_size, sampling_ratio=sampling_ratio,
+            max_extent_cells=window - 10,
+        ),
+        feature_pyramid,
+    )
+    (grad_pyramid,) = vjp(g)
+    return grad_pyramid, jnp.zeros_like(rois)
+
+
+multilevel_roi_align_fast.defvjp(_fast_fwd, _fast_bwd)

@@ -52,9 +52,9 @@ def roi_align(
     bin_w = rw / output_size  # (R,)
     bin_h = rh / output_size
 
-    bins = jnp.arange(output_size, dtype=features.dtype)  # (S,)
+    bins = jnp.arange(output_size, dtype=jnp.float32)  # (S,)
 
-    out = jnp.zeros((rois.shape[0], output_size, output_size, c), features.dtype)
+    out = jnp.zeros((rois.shape[0], output_size, output_size, c), jnp.float32)
     for iy in range(sampling_ratio):
         fy = (iy + 0.5) / sampling_ratio
         # (R, S): absolute y of this sample row in every bin
@@ -63,7 +63,10 @@ def roi_align(
             fx = (ix + 0.5) / sampling_ratio
             sx = x1[:, None] + (bins[None, :] + fx) * bin_w[:, None]
             out = out + _bilinear_gather(flat, h, w, sy, sx)
-    return out / (sampling_ratio * sampling_ratio)
+    # f32 interpolation arithmetic, result back in the features' dtype
+    # (keeps the Pallas kernel and this reference bit-for-bit interchangeable
+    # inside a bf16 train graph, including cotangent dtypes in custom_vjp).
+    return (out / (sampling_ratio * sampling_ratio)).astype(features.dtype)
 
 
 def _bilinear_gather(flat, h, w, sy, sx):
@@ -108,18 +111,36 @@ def _bilinear_gather(flat, h, w, sy, sx):
     return val * inside[..., None]
 
 
+# Default bound on a roi's extent in feature cells at its assigned level.
+# MUST equal the Pallas kernel's window - 10 (ops/pallas/roi_align.py,
+# default T=48: 1 cell of bilinear margin per side + up to 7 cells lost to
+# the 8-aligned x-origin + 1 tap) so the XLA and Pallas paths assign rois
+# to identical levels.  Rois whose span would exceed it (pathologically
+# thin-and-long boxes — small area, huge extent — that the area heuristic
+# sends to a fine level) are bumped to a coarser level where they fit.
+MAX_EXTENT_CELLS = 38
+
+
 def fpn_level_assignment(
     rois: jnp.ndarray,
     min_level: int = 2,
     max_level: int = 5,
     canonical_scale: float = 224.0,
     canonical_level: int = 4,
+    max_extent_cells: int | None = MAX_EXTENT_CELLS,
 ) -> jnp.ndarray:
-    """FPN paper eq. 1: level k = k0 + log2(sqrt(area)/224), clamped."""
+    """FPN paper eq. 1: level k = k0 + log2(sqrt(area)/224), clamped; plus
+    the extent bound above (pass ``max_extent_cells=None`` for the pure
+    paper heuristic)."""
     w = jnp.maximum(rois[:, 2] - rois[:, 0], 1e-6)
     h = jnp.maximum(rois[:, 3] - rois[:, 1], 1e-6)
     k = canonical_level + jnp.log2(jnp.sqrt(w * h) / canonical_scale)
-    return jnp.clip(jnp.floor(k).astype(jnp.int32), min_level, max_level)
+    k = jnp.floor(k).astype(jnp.int32)
+    if max_extent_cells is not None:
+        extent = jnp.maximum(w, h)
+        k_fit = jnp.ceil(jnp.log2(extent / max_extent_cells)).astype(jnp.int32)
+        k = jnp.maximum(k, k_fit)
+    return jnp.clip(k, min_level, max_level)
 
 
 def multilevel_roi_align(
@@ -127,17 +148,22 @@ def multilevel_roi_align(
     rois: jnp.ndarray,
     output_size: int = 7,
     sampling_ratio: int = 2,
+    max_extent_cells: int | None = MAX_EXTENT_CELLS,
 ) -> jnp.ndarray:
     """ROIAlign over an FPN pyramid with per-roi level assignment.
 
     ``feature_pyramid`` maps level -> (H_l, W_l, C); stride of level l is
     2**l.  Every roi is pooled from every level and the per-roi one-hot
     level indicator selects the result — 4x redundant compute but fully
-    static shapes and no host interaction; the Pallas path will gather
-    per-level instead.
+    static shapes and no host interaction; the Pallas kernel
+    (ops/pallas/roi_align.py) gathers per-level instead and is the
+    performance path on TPU.
     """
     levels = sorted(feature_pyramid.keys())
-    assignment = fpn_level_assignment(rois, min_level=levels[0], max_level=levels[-1])
+    assignment = fpn_level_assignment(
+        rois, min_level=levels[0], max_level=levels[-1],
+        max_extent_cells=max_extent_cells,
+    )
     out = None
     for lvl in levels:
         pooled = roi_align(

@@ -130,6 +130,8 @@ class RoiSamples(NamedTuple):
     label_weights: jnp.ndarray # (B,) 1.0 for real samples, 0.0 for padding
     bbox_targets: jnp.ndarray  # (B, 4) encoded vs the roi (fg rows only)
     fg_mask: jnp.ndarray       # (B,) bool
+    gt_indices: jnp.ndarray    # (B,) int32 matched gt row (fg rows only
+                               # meaningful; mask-target lookup)
 
 
 def sample_rois(
@@ -201,4 +203,5 @@ def sample_rois(
         label_weights=picked.astype(jnp.float32),
         bbox_targets=targets,
         fg_mask=out_fg,
+        gt_indices=matched_gt.astype(jnp.int32),
     )

@@ -84,6 +84,15 @@ def train(
     )
     if state is None:
         state = fresh_state
+    else:
+        # Continuation from an earlier phase (alternate training): keep the
+        # learned params + BN stats, but take this phase's optimizer state
+        # (freeze masks change its pytree) and restart step/schedule —
+        # matching the reference, where each phase is a fresh fit() over
+        # params loaded from the previous phase's checkpoint.
+        state = fresh_state.replace(
+            params=state.params, model_state=state.model_state
+        )
     steps = total_steps if total_steps is not None else cfg.train.schedule.total_steps
     ckpt_dir = f"{workdir or cfg.workdir}/{cfg.name}/ckpt"
     if resume and latest_step(ckpt_dir) is not None:

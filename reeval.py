@@ -1,0 +1,6 @@
+#!/usr/bin/env python
+"""Entry point — see mx_rcnn_tpu/cli/reeval_cli.py (reference: reeval driver)."""
+from mx_rcnn_tpu.cli.reeval_cli import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# BASELINE config #5: Mask R-CNN ResNet-50-FPN, COCO2017 instance segmentation.
+set -ex
+python train.py --config mask_r50_fpn_coco --workdir runs "$@"

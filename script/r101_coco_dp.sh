@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# BASELINE config #3: Faster R-CNN ResNet-101 C4, COCO2017, data-parallel over
+# all visible chips (reference: --gpus 0,1,... + kvstore; here: the device mesh).
+set -ex
+python train.py --config r101_coco --workdir runs "$@"

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# BASELINE config #4: Faster R-CNN ResNet-101-FPN multi-scale, COCO2017 (ROIAlign path).
+set -ex
+python train.py --config r101_fpn_coco --workdir runs "$@"

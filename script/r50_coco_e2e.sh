@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# BASELINE config #2: Faster R-CNN ResNet-50 C4, COCO2017, end-to-end, single host.
+set -ex
+python train.py --config r50_coco --workdir runs "$@"

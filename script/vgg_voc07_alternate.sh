@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# BASELINE config #1: Faster R-CNN VGG-16, PASCAL VOC 2007 trainval,
+# 4-step alternate training (reference: script/vgg_voc07.sh + train_alternate.py).
+set -ex
+python train_alternate.py --config vgg16_voc07 --workdir runs "$@"
+python test.py --config vgg16_voc07 --workdir runs --use-07-metric "$@"

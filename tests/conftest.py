@@ -33,6 +33,13 @@ for _name in list(_xb._backend_factories):
 # through the config API, not the env var.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: the integration tests jit full ResNet train
+# steps; caching makes re-runs of the suite seconds instead of minutes.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
 import numpy as np
 import pytest
 
