@@ -40,16 +40,17 @@ GT_MASK_SIZE = 112
 
 
 def _load_image(rec: RoiRecord) -> np.ndarray:
+    """uint8 RGB from disk (float32 for in-memory synthetic images)."""
     if rec.image_array is not None:
         return rec.image_array
     if cv2 is None:  # pragma: no cover
         from PIL import Image
 
-        return np.asarray(Image.open(rec.image_path).convert("RGB"), np.float32)
+        return np.asarray(Image.open(rec.image_path).convert("RGB"), np.uint8)
     img = cv2.imread(rec.image_path, cv2.IMREAD_COLOR)
     if img is None:
         raise FileNotFoundError(rec.image_path)
-    return cv2.cvtColor(img, cv2.COLOR_BGR2RGB).astype(np.float32)
+    return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
 
 
 def _rasterize_mask(seg, box: np.ndarray) -> np.ndarray:
@@ -145,10 +146,30 @@ class DetectionLoader:
         boxes = rec.boxes
         if flip:
             img, boxes = hflip(img, boxes, rec.width)
-        img, boxes, scale, (th, tw) = letterbox(
-            img, boxes, self.cfg.image_size, self.cfg.short_side, self.cfg.max_side
-        )
-        img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
+        scale = self.record_scale(rec)
+        nh = int(round(rec.height * scale))
+        nw = int(round(rec.width * scale))
+        native = None
+        if img.dtype == np.uint8:
+            # Fused C++ resize+pad+normalize (mx_rcnn_tpu/native); replaces
+            # the reference's two-pass cv2-resize + numpy mean-subtract
+            # (rcnn/io/image.py) on the loader hot path.
+            from mx_rcnn_tpu.native import letterbox_normalize
+
+            native = letterbox_normalize(
+                img, self.cfg.image_size, nh, nw, scale,
+                self.cfg.pixel_mean, self.cfg.pixel_std,
+            )
+        if native is not None:
+            img = native
+            boxes = boxes.astype(np.float32) * scale
+            th, tw = nh, nw
+        else:
+            img, boxes, scale, (th, tw) = letterbox(
+                img.astype(np.float32), boxes, self.cfg.image_size,
+                self.cfg.short_side, self.cfg.max_side,
+            )
+            img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
         g = self.cfg.max_gt_boxes
         n = min(len(boxes), g)
         gt_boxes = np.zeros((g, 4), np.float32)

@@ -58,7 +58,12 @@ def paste_mask(
 
 
 def rle_encode(binary: np.ndarray) -> dict:
-    """(h, w) bool → COCO-style column-major RLE."""
+    """(h, w) bool → COCO-style column-major RLE (C++ when built)."""
+    from mx_rcnn_tpu.native import rle_encode_native
+
+    native = rle_encode_native(binary)
+    if native is not None:
+        return native
     h, w = binary.shape
     flat = np.asarray(binary, np.uint8).T.reshape(-1)  # Fortran order
     # Run-length: indices where the value changes.
@@ -109,7 +114,12 @@ def _intersection(a: dict, b: dict) -> int:
 
 
 def rle_iou(dts: list[dict], gts: list[dict]) -> np.ndarray:
-    """(n dts) x (m gts) mask IoU matrix."""
+    """(n dts) x (m gts) mask IoU matrix (C++ when built)."""
+    from mx_rcnn_tpu.native import rle_iou_native
+
+    native = rle_iou_native(dts, gts)
+    if native is not None:
+        return native
     n, m = len(dts), len(gts)
     out = np.zeros((n, m))
     d_areas = [rle_area(d) for d in dts]

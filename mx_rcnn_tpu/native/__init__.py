@@ -1,0 +1,29 @@
+"""Native (C++) host-side runtime components.
+
+The reference keeps its host-side hot loops in compiled code — Cython
+``bbox.pyx``/``cpu_nms.pyx``, the vendored COCO ``maskApi.c``, and the CUDA
+``nms_kernel.cu`` (SURVEY.md §3.5).  On TPU the device-side equivalents are
+XLA/Pallas; what remains on the host — image letterboxing in the input
+pipeline, RLE mask arithmetic in evaluation, greedy NMS as a test oracle —
+is implemented here in C++ (``src/native.cc``) behind a ctypes interface.
+
+Build: ``python -m mx_rcnn_tpu.native.build`` (direct g++, no setuptools);
+every entry point falls back to the numpy implementation when the shared
+library is absent, so the package works un-built.
+"""
+
+from mx_rcnn_tpu.native.lib import (
+    available,
+    cpu_nms,
+    letterbox_normalize,
+    rle_encode_native,
+    rle_iou_native,
+)
+
+__all__ = [
+    "available",
+    "cpu_nms",
+    "letterbox_normalize",
+    "rle_encode_native",
+    "rle_iou_native",
+]

@@ -1,0 +1,41 @@
+"""Build the native shared library with g++ (no setuptools, no pybind11).
+
+Usage: ``python -m mx_rcnn_tpu.native.build``; the test suite and package
+import both tolerate an un-built tree (numpy fallbacks take over).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(PKG_DIR, "src", "native.cc")
+OUT = os.path.join(PKG_DIR, "_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    # Portable ISA (no -march=native): the .so may be built once and used
+    # from a shared filesystem on heterogeneous hosts; a SIGILL in the data
+    # loader is worse than a few percent of scalar-loop speed.
+    # Compile to a temp path + atomic rename so concurrent builders
+    # (multi-process loaders, parallel test workers) never dlopen a
+    # half-written file.
+    tmp = f"{OUT}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", SRC, "-o", tmp,
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    try:
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, OUT)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()
