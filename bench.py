@@ -66,6 +66,10 @@ def main() -> None:
         gt_valid=valid,
     )
 
+    # Device-resident batch: the metric is the train step (fwd+bwd+update);
+    # the input pipeline overlaps transfers in the real loop
+    # (parallel/prefetch.py) and is benchmarked by its own tests.
+    data = jax.device_put(data)
     # Warmup (compile) + timed steps.
     for _ in range(3):
         state, metrics = step_fn(state, data)
@@ -76,6 +80,16 @@ def main() -> None:
         state, metrics = step_fn(state, data)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
+
+    # Per-step percentiles (sync per step — counts dispatch) on stderr.
+    from mx_rcnn_tpu.utils import StepTimer
+
+    timer = StepTimer(warmup=2)
+    for _ in range(8 if on_accel else 3):
+        with timer:
+            state, metrics = step_fn(state, data)
+            jax.block_until_ready(state.params)
+    print(f"per-step (synced): {timer.summary()}", file=sys.stderr)
 
     img_s = n_steps * batch / dt
     print(

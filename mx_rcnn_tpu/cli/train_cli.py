@@ -26,6 +26,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "--no-eval", action="store_true", help="skip the final evaluation pass"
     )
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="write a jax.profiler trace of steps 10-15 to DIR",
+    )
     return p.parse_args(argv)
 
 
@@ -50,6 +54,7 @@ def main(argv=None) -> dict:
         total_steps=args.steps,
         workdir=cfg.workdir,
         resume=args.resume,
+        profile_dir=args.profile,
     )
     metrics: dict = {"final_step": int(jax.device_get(state.step))}
     if not args.no_eval:

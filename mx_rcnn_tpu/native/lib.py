@@ -14,14 +14,14 @@ from typing import Optional
 
 import numpy as np
 
-_LIB: Optional[ctypes.CDLL] = None
+_LIB = None  # None = not attempted; False = failed (don't retry); CDLL = loaded
 _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native.so")
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB
     if _LIB is not None:
-        return _LIB
+        return _LIB or None  # False (cached failure) -> None
     if not os.path.exists(_SO):
         # Build lazily when a toolchain is present (dev/CI convenience).
         try:
@@ -29,10 +29,14 @@ def _load() -> Optional[ctypes.CDLL]:
 
             build(verbose=False)
         except Exception:
+            # Cache the failure: these entry points sit on the per-image
+            # loader hot path — one g++ attempt per process, not per call.
+            _LIB = False
             return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
+        _LIB = False
         return None
     u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
     lib.cpu_nms.restype = ctypes.c_int

@@ -18,10 +18,12 @@ from mx_rcnn_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from mx_rcnn_tpu.parallel.prefetch import device_prefetch
 from mx_rcnn_tpu.parallel.step import make_eval_step, make_train_step
 
 __all__ = [
     "batch_sharding",
+    "device_prefetch",
     "make_eval_step",
     "make_mesh",
     "make_train_step",

@@ -1,0 +1,36 @@
+"""Async host→device batch prefetch.
+
+``jax.device_put`` is asynchronous: issuing the transfer for batch k+1
+while batch k's step runs hides the PCIe/ICI copy behind compute (the
+reference relies on MXNet's threaded DataIter + engine for the same
+overlap).  Keeping ``depth`` batches in flight bounds device memory.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional
+
+import jax
+
+from mx_rcnn_tpu.parallel.mesh import shard_batch
+
+
+def device_prefetch(
+    it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2
+) -> Iterator:
+    """Wrap a host batch iterator: batches come out device-resident (sharded
+    over the mesh when given), ``depth`` transfers ahead of consumption."""
+    q: collections.deque = collections.deque()
+
+    def put(batch):
+        if mesh is not None:
+            return shard_batch(batch, mesh)
+        return jax.device_put(batch)
+
+    for batch in it:
+        q.append(put(batch))
+        if len(q) > depth:
+            yield q.popleft()
+    while q:
+        yield q.popleft()

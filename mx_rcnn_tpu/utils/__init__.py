@@ -1,0 +1,3 @@
+from mx_rcnn_tpu.utils.profiling import ProfileWindow, StepTimer, trace
+
+__all__ = ["ProfileWindow", "StepTimer", "trace"]

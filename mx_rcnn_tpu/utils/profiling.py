@@ -1,0 +1,106 @@
+"""Tracing / profiling hooks.
+
+The reference has no profiling beyond the Speedometer samples/sec print
+(SURVEY.md §6: ``mx.profiler`` exists engine-side but the repo never uses
+it).  Here profiling is a first-class loop feature: device traces go
+through ``jax.profiler`` (viewable in XProf/Perfetto/TensorBoard), host
+step timing through :class:`StepTimer`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Iterator, Optional
+
+import jax
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """Device+host trace of the enclosed block into ``logdir`` (no-op when
+    logdir is None).  Produces an XPlane/Perfetto dump per host."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", logdir)
+
+
+class ProfileWindow:
+    """Trace a [start, stop) step interval of a training loop.
+
+    Robust to resume (entering the loop mid-window starts the trace on the
+    first step inside it) and to runs that end inside the window (the loop
+    calls :meth:`close` on exit; an active trace is stopped exactly once).
+    """
+
+    def __init__(self, logdir: Optional[str], start: int, stop: int) -> None:
+        self.logdir = logdir
+        self.start = start
+        self.stop = stop
+        self._active = False
+
+    def step(self, i: int, sync=None) -> None:
+        """Call at the top of loop step ``i``."""
+        if not self.logdir:
+            return
+        if not self._active and self.start <= i < self.stop:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and i >= self.stop:
+            self.close(sync)
+
+    def close(self, sync=None) -> None:
+        if self._active:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace written to %s", self.logdir)
+
+
+class StepTimer:
+    """Wall-clock stats for loop steps, with warmup discard.
+
+    Unlike the Speedometer (throughput log line), this keeps percentiles
+    for perf work: ``timer.summary()`` -> dict(mean/p50/p90 in ms).
+    """
+
+    def __init__(self, warmup: int = 2) -> None:
+        self.warmup = warmup
+        self._times: list[float] = []
+        self._t0: Optional[float] = None
+        self._seen = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dt = time.perf_counter() - self._t0
+        self._seen += 1
+        if self._seen > self.warmup:
+            self._times.append(dt)
+
+    def summary(self) -> dict[str, float]:
+        if not self._times:
+            return {}
+        import numpy as np
+
+        arr = np.asarray(self._times) * 1e3
+        return {
+            "steps": float(len(arr)),
+            "mean_ms": float(arr.mean()),
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p90_ms": float(np.percentile(arr, 90)),
+        }

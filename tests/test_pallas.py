@@ -79,7 +79,49 @@ class TestPallasRoiAlign:
             np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
         )
 
-    def test_gradient_not_needed(self):
-        """The pooled features feed the head; gradients flow to features via
-        the XLA path in training (kernel is inference/perf path for now)."""
-        assert True
+    def test_custom_vjp_matches_xla_grad(self, rng):
+        """multilevel_roi_align_fast: pallas forward, XLA backward — its
+        feature gradients must equal differentiating the XLA path."""
+        import jax
+
+        from mx_rcnn_tpu.ops.pallas.roi_align import multilevel_roi_align_fast
+
+        pyr = _pyramid(rng, canvas=128, channels=8)
+        rois = _random_rois(rng, 8, canvas=128)
+
+        def loss_ref(p):
+            return (multilevel_roi_align(p, rois) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref)(pyr)
+        # The custom_vjp backward is literally jax.vjp of the XLA path, so
+        # equality holds by construction; verify the bwd plumbing directly
+        # (the pallas forward itself only lowers on TPU / interpret mode).
+        from mx_rcnn_tpu.ops.pallas import roi_align as pra
+
+        g_pyr, g_rois = pra._fast_bwd(
+            7, 2, 48, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
+        )
+        for l in pyr:
+            np.testing.assert_allclose(
+                np.asarray(g_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
+            )
+        assert float(jnp.abs(g_rois).max()) == 0.0
+
+
+class TestPallasNms:
+    def test_matches_xla_nms(self, rng):
+        from mx_rcnn_tpu.ops.nms import nms_mask
+        from mx_rcnn_tpu.ops.pallas.nms import nms_mask_pallas
+
+        for n in (7, 64, 200, 513):
+            ctr = rng.rand(n, 2) * 300
+            wh = rng.rand(n, 2) * 80 + 2
+            boxes = jnp.asarray(np.concatenate([ctr - wh / 2, ctr + wh / 2], 1),
+                                jnp.float32)
+            scores = jnp.asarray(rng.rand(n), jnp.float32)
+            valid = jnp.asarray(rng.rand(n) > 0.2)
+            ref = np.asarray(nms_mask(boxes, scores, 0.5, valid))
+            out = np.asarray(
+                nms_mask_pallas(boxes, scores, 0.5, valid, interpret=True)
+            )
+            np.testing.assert_array_equal(out, ref)
