@@ -30,6 +30,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--profile", default=None, metavar="DIR",
         help="write a jax.profiler trace of steps 10-15 to DIR",
     )
+    p.add_argument(
+        "--pretrained", default=None, metavar="PTH",
+        help="torchvision-style ResNet .pth to seed the backbone "
+        "(reference: --pretrained imagenet params)",
+    )
     return p.parse_args(argv)
 
 
@@ -40,9 +45,10 @@ def main(argv=None) -> dict:
 
     import jax
 
-    from mx_rcnn_tpu.parallel import make_mesh
+    from mx_rcnn_tpu.parallel import initialize, make_mesh
     from mx_rcnn_tpu.train.loop import train
 
+    initialize()  # multi-host runtime (no-op single-process)
     mesh = make_mesh() if jax.device_count() > 1 else None
     n_dev = mesh.size if mesh is not None else 1
     log.info(
@@ -55,6 +61,7 @@ def main(argv=None) -> dict:
         workdir=cfg.workdir,
         resume=args.resume,
         profile_dir=args.profile,
+        pretrained=args.pretrained,
     )
     metrics: dict = {"final_step": int(jax.device_get(state.step))}
     if not args.no_eval:

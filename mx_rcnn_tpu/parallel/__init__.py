@@ -12,6 +12,7 @@ semantic equivalent of ``dist_sync`` + ``device`` aggregation with none of
 the machinery.
 """
 
+from mx_rcnn_tpu.parallel.distributed import initialize
 from mx_rcnn_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
@@ -24,6 +25,7 @@ from mx_rcnn_tpu.parallel.step import make_eval_step, make_train_step
 __all__ = [
     "batch_sharding",
     "device_prefetch",
+    "initialize",
     "make_eval_step",
     "make_mesh",
     "make_train_step",

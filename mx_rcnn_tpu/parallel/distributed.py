@@ -1,0 +1,81 @@
+"""Multi-host (multi-process) runtime initialization.
+
+The reference scales past one host with MXNet KVStore ``dist_sync`` on a
+ps-lite parameter server: ``tools/launch.py`` spawns scheduler/server/worker
+processes wired by env vars, workers push gradients and pull weights each
+iteration (SURVEY.md §3.8).  The TPU-native equivalent has no server role
+at all: every host runs the same program, :func:`initialize` wires them
+into one jax runtime (coordination service + global device view), and the
+gradient all-reduce is an XLA collective over ICI/DCN inside the jitted
+step.  Synchronous and deterministic — ``dist_sync`` semantics with no
+push/pull machinery.
+
+Launch parity:
+
+  reference: python tools/launch.py -n 4 ... python train_end2end.py --kv-store dist_sync
+  here:      srun/gcloud per host: python train.py --config r101_coco
+             (TPU pods: the runtime's env markers trigger autodetecting
+             jax.distributed.initialize(); CPU/GPU clusters: pass
+             coordinator/rank/count explicitly or via
+             JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES)
+
+The data loader shards the roidb per process (``roidb[rank::world]``,
+data/loader.py) and :func:`mx_rcnn_tpu.parallel.shard_batch` assembles
+global arrays from per-host shards — together with this module that is the
+complete multi-host story.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host runtime (no-op for single-process runs).
+
+    On TPU pods all arguments autodetect from the TPU runtime metadata.
+    Elsewhere pass them explicitly or via JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID.  Must run before the first device
+    query in the process.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_n = os.environ.get("JAX_NUM_PROCESSES")
+    n = num_processes if num_processes is not None else (
+        int(env_n) if env_n else None
+    )
+    env_id = os.environ.get("JAX_PROCESS_ID")
+    pid = process_id if process_id is not None else (
+        int(env_id) if env_id else None
+    )
+    explicit = coordinator_address is not None or (n is not None and n > 1)
+    # Multi-host TPU pods carry runtime metadata jax autodetects from; these
+    # markers are how we know to join without explicit configuration.
+    tpu_pod = any(
+        os.environ.get(k)
+        for k in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
+                  "CLOUD_TPU_TASK_ID")
+    )
+    if not explicit and not tpu_pod:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=n,
+        process_id=pid,
+    )
+    log.info(
+        "distributed runtime up: process %d/%d, %d local + %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )

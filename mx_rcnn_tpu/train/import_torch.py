@@ -1,0 +1,145 @@
+"""Import torchvision-style ResNet checkpoints into the flax backbone.
+
+Parity with the reference's pretrained-model flow: its drivers call
+``load_param(pretrained, epoch)`` (``rcnn/utils/load_model.py``) on
+ImageNet ``.params`` files before training.  Users coming from the torch
+ecosystem hold ``resnet50/101-*.pth`` state_dicts instead; this module maps
+them onto :class:`mx_rcnn_tpu.models.resnet.ResNet` (weights into
+``params``, BN statistics into the frozen ``constants`` collection).
+
+No network access is assumed anywhere — the file must already be on disk.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """torch OIHW -> flax HWIO."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def map_torch_resnet(state_dict: Mapping[str, "np.ndarray"]) -> tuple[dict, dict]:
+    """torchvision ResNet state_dict -> (params, constants) subtrees for the
+    ``backbone`` module.  Accepts numpy arrays or torch tensors."""
+
+    def arr(key: str) -> np.ndarray:
+        v = state_dict[key]
+        if hasattr(v, "detach"):  # torch tensor without importing torch here
+            v = v.detach().cpu().numpy()
+        return np.asarray(v, np.float32)
+
+    params: dict = {}
+    constants: dict = {}
+
+    def put_conv(flax_name: str, tkey: str) -> None:
+        params[flax_name] = {"kernel": _conv_kernel(arr(tkey + ".weight"))}
+
+    def put_bn(flax_name: str, tkey: str) -> None:
+        constants[flax_name] = {
+            "scale": arr(tkey + ".weight"),
+            "bias": arr(tkey + ".bias"),
+            "mean": arr(tkey + ".running_mean"),
+            "var": arr(tkey + ".running_var"),
+        }
+
+    put_conv("conv1", "conv1")
+    put_bn("bn1", "bn1")
+
+    # Count blocks per layer from the keys (works for 50/101/152).
+    import re
+
+    n_blocks = [0, 0, 0, 0]
+    for k in state_dict:
+        m = re.match(r"layer(\d)\.(\d+)\.conv1\.weight", k)
+        if m:
+            li, bi = int(m.group(1)), int(m.group(2))
+            n_blocks[li - 1] = max(n_blocks[li - 1], bi + 1)
+
+    for li in range(1, 5):
+        for b in range(n_blocks[li - 1]):
+            t = f"layer{li}.{b}"
+            f = f"layer{li}_block{b}"
+            blk_p: dict = {}
+            blk_c: dict = {}
+            for ci in (1, 2, 3):
+                blk_p[f"conv{ci}"] = {
+                    "kernel": _conv_kernel(arr(f"{t}.conv{ci}.weight"))
+                }
+                blk_c[f"bn{ci}"] = {
+                    "scale": arr(f"{t}.bn{ci}.weight"),
+                    "bias": arr(f"{t}.bn{ci}.bias"),
+                    "mean": arr(f"{t}.bn{ci}.running_mean"),
+                    "var": arr(f"{t}.bn{ci}.running_var"),
+                }
+            if f"{t}.downsample.0.weight" in state_dict:
+                blk_p["downsample_conv"] = {
+                    "kernel": _conv_kernel(arr(f"{t}.downsample.0.weight"))
+                }
+                blk_c["downsample_bn"] = {
+                    "scale": arr(f"{t}.downsample.1.weight"),
+                    "bias": arr(f"{t}.downsample.1.bias"),
+                    "mean": arr(f"{t}.downsample.1.running_mean"),
+                    "var": arr(f"{t}.downsample.1.running_var"),
+                }
+            params[f] = blk_p
+            constants[f] = blk_c
+
+    return params, constants
+
+
+def load_pretrained_backbone(variables: dict, pth_path: str) -> dict:
+    """Return a copy of ``variables`` with the backbone initialized from a
+    torchvision ResNet ``.pth`` state_dict on disk.
+
+    The reference's ``load_param`` + arg/aux-dict surgery, flax style: only
+    keys present in both trees are replaced; shapes are validated.
+    """
+    import torch
+
+    sd = torch.load(pth_path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    params_in, constants_in = map_torch_resnet(sd)
+
+    out = {k: dict(v) for k, v in variables.items()}
+    consumed = [0]
+
+    def merge(dst: dict, src: dict, path: str) -> dict:
+        merged = dict(dst)
+        for k, v in src.items():
+            if k not in dst:
+                continue  # e.g. fc layer absent from the detection backbone
+            if isinstance(v, dict):
+                merged[k] = merge(dst[k], v, f"{path}/{k}")
+            else:
+                if tuple(dst[k].shape) != tuple(v.shape):
+                    raise ValueError(
+                        f"shape mismatch at {path}/{k}: "
+                        f"checkpoint {v.shape} vs model {dst[k].shape}"
+                    )
+                merged[k] = v.astype(np.asarray(dst[k]).dtype)
+                consumed[0] += 1
+        return merged
+
+    out["params"] = dict(out["params"])
+    out["params"]["backbone"] = merge(
+        out["params"]["backbone"], params_in, "params/backbone"
+    )
+    if "constants" in out:
+        out["constants"] = dict(out["constants"])
+        out["constants"]["backbone"] = merge(
+            out["constants"]["backbone"], constants_in, "constants/backbone"
+        )
+    if consumed[0] == 0:
+        # A checkpoint that matches nothing is a wrong-architecture file
+        # (e.g. a resnet .pth against a VGG backbone) — silently training
+        # from random init would masquerade as bad hyperparameters.
+        raise ValueError(
+            f"{pth_path} matched no parameter in the model's backbone tree; "
+            "checkpoint/backbone architecture mismatch"
+        )
+    return out

@@ -27,7 +27,11 @@ from mx_rcnn_tpu.parallel import (
     replicated,
 )
 from mx_rcnn_tpu.train.checkpoint import latest_step, restore_checkpoint, save_checkpoint
-from mx_rcnn_tpu.train.metrics import Speedometer, device_metrics_to_host
+from mx_rcnn_tpu.train.metrics import (
+    ScalarWriter,
+    Speedometer,
+    device_metrics_to_host,
+)
 from mx_rcnn_tpu.train.optim import make_optimizer
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
 from mx_rcnn_tpu.utils import ProfileWindow
@@ -44,8 +48,13 @@ FREEZE_PREFIXES = {
 
 
 def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
-              extra_freeze: tuple[str, ...] = ()):
-    """Model + optimizer + fresh state + sharded step for a config."""
+              extra_freeze: tuple[str, ...] = (),
+              pretrained: Optional[str] = None):
+    """Model + optimizer + fresh state + sharded step for a config.
+
+    ``pretrained``: path to a torchvision-style ResNet ``.pth`` whose
+    weights+BN stats seed the backbone (reference: ``load_param`` on the
+    ImageNet ``.params`` file before training)."""
     model = TwoStageDetector(cfg=cfg.model)
     rng = jax.random.PRNGKey(cfg.train.seed)
     n_dev = mesh.size if mesh is not None else 1
@@ -59,6 +68,15 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     # Init params first (on host) so the freeze mask can see the tree.
     probe_tx, schedule = make_optimizer(cfg.train, None, lr_scale=lr_scale)
     state = create_train_state(model, probe_tx, rng, cfg.data.image_size, batch=1)
+    if pretrained:
+        from mx_rcnn_tpu.train.import_torch import load_pretrained_backbone
+        from mx_rcnn_tpu.train.state import state_variables
+
+        variables = load_pretrained_backbone(state_variables(state), pretrained)
+        state = state.replace(
+            params=variables["params"],
+            model_state={k: v for k, v in variables.items() if k != "params"},
+        )
     if freeze:
         tx, schedule = make_optimizer(
             cfg.train, state.params, lr_scale=lr_scale, freeze_prefixes=freeze
@@ -81,6 +99,7 @@ def train(
     loader: Optional[DetectionLoader] = None,
     profile_dir: Optional[str] = None,
     profile_steps: tuple[int, int] = (10, 15),
+    pretrained: Optional[str] = None,
 ) -> TrainState:
     """Train for ``total_steps`` (default: cfg schedule length); returns the
     final state (host-fetchable).  Pass ``state`` to continue from an earlier
@@ -89,7 +108,7 @@ def train(
     if mesh is None and jax.device_count() > 1:
         mesh = make_mesh()
     model, tx, fresh_state, step_fn, global_batch = build_all(
-        cfg, mesh, extra_freeze=extra_freeze
+        cfg, mesh, extra_freeze=extra_freeze, pretrained=pretrained
     )
     if state is None:
         state = fresh_state
@@ -125,6 +144,11 @@ def train(
 
     speedo = Speedometer(global_batch, cfg.train.log_every)
     start = int(state.step)
+    writer = None
+    if workdir and jax.process_index() == 0:
+        writer = ScalarWriter(
+            f"{workdir}/{cfg.name}/metrics.jsonl", resume=start > 0
+        )
     # Device prefetch: the host->device copy of batch k+1 overlaps batch
     # k's step (12MB/image at 1024^2 — unhidden it costs more than the
     # fwd+bwd compute on a v5e).
@@ -135,10 +159,15 @@ def train(
         batch = next(it)
         state, metrics = step_fn(state, batch)
         if (i + 1) % cfg.train.log_every == 0 or i == start:
-            speedo(i + 1, device_metrics_to_host(metrics))
+            host_metrics = device_metrics_to_host(metrics)
+            speedo(i + 1, host_metrics)
+            if writer:
+                writer.write(i + 1, host_metrics)
         if workdir and (i + 1) % cfg.train.checkpoint_every == 0:
             save_checkpoint(ckpt_dir, jax.device_get(state))
     profiler.close(sync=state.params)
+    if writer:
+        writer.close()
     if workdir:
         save_checkpoint(ckpt_dir, jax.device_get(state), wait=True)
     return state

@@ -65,3 +65,29 @@ def device_metrics_to_host(metrics: dict) -> dict[str, float]:
     """One blocking transfer for the whole metric dict."""
     flat = jax.device_get(metrics)
     return {k: float(np.asarray(v)) for k, v in flat.items()}
+
+
+class ScalarWriter:
+    """Append-only jsonl scalar log (one record per log point).
+
+    The observability surface the reference lacks (SURVEY.md §6 — its only
+    artifacts are stdout lines): machine-readable training curves under the
+    workdir, one ``{"step": ..., metric: value, ...}`` object per line.
+    Plotting/TensorBoard ingestion stays external; the contract is the file.
+    """
+
+    def __init__(self, path: str, resume: bool = False) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Fresh runs truncate: appending a second from-step-0 curve onto an
+        # old one would leave a non-monotonic file for ingestors.
+        self._f = open(path, "a" if resume else "w", buffering=1)
+
+    def write(self, step: int, metrics: dict) -> None:
+        import json
+
+        self._f.write(json.dumps({"step": step, **metrics}) + "\n")
+
+    def close(self) -> None:
+        self._f.close()

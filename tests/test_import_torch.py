@@ -1,0 +1,107 @@
+"""Torch checkpoint import: torchvision key layout -> flax backbone."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mx_rcnn_tpu.models.resnet import STAGE_BLOCKS, ResNet  # noqa: E402
+from mx_rcnn_tpu.train.import_torch import (  # noqa: E402
+    load_pretrained_backbone,
+    map_torch_resnet,
+)
+
+
+def _fake_torchvision_sd(blocks=(3, 4, 6, 3), rng=None):
+    """Random state_dict with torchvision resnet key names/shapes."""
+    rng = rng or np.random.RandomState(0)
+    sd = {}
+
+    def conv(k, cout, cin, ks):
+        sd[k + ".weight"] = torch.tensor(
+            rng.randn(cout, cin, ks, ks).astype(np.float32) * 0.05
+        )
+
+    def bn(k, c):
+        sd[k + ".weight"] = torch.tensor(rng.rand(c).astype(np.float32) + 0.5)
+        sd[k + ".bias"] = torch.tensor(rng.randn(c).astype(np.float32) * 0.1)
+        sd[k + ".running_mean"] = torch.tensor(rng.randn(c).astype(np.float32) * 0.1)
+        sd[k + ".running_var"] = torch.tensor(rng.rand(c).astype(np.float32) + 0.5)
+
+    conv("conv1", 64, 3, 7)
+    bn("bn1", 64)
+    cin = 64
+    for li, (n, width) in enumerate(zip(blocks, (64, 128, 256, 512)), start=1):
+        for b in range(n):
+            base = f"layer{li}.{b}"
+            conv(base + ".conv1", width, cin if b == 0 else width * 4, 1)
+            bn(base + ".bn1", width)
+            conv(base + ".conv2", width, width, 3)
+            bn(base + ".bn2", width)
+            conv(base + ".conv3", width * 4, width, 1)
+            bn(base + ".bn3", width * 4)
+            if b == 0:
+                conv(base + ".downsample.0", width * 4, cin, 1)
+                bn(base + ".downsample.1", width * 4)
+        cin = width * 4
+    return sd
+
+
+class TestMapping:
+    def test_full_tree_and_forward_changes(self, tmp_path):
+        sd = _fake_torchvision_sd()
+        model = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32)
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 64, 64, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+
+        params, constants = map_torch_resnet(sd)
+        # Every flax param/constant leaf is covered by the mapping.
+        assert set(params) == set(variables["params"])
+        assert set(constants) == set(variables["constants"])
+
+        pth = str(tmp_path / "fake_resnet50.pth")
+        torch.save(sd, pth)
+        wrapped = {"params": {"backbone": variables["params"]},
+                   "constants": {"backbone": variables["constants"]}}
+        loaded = load_pretrained_backbone(wrapped, pth)
+
+        # kernels transposed OIHW->HWIO
+        np.testing.assert_allclose(
+            loaded["params"]["backbone"]["conv1"]["kernel"],
+            np.transpose(sd["conv1.weight"].numpy(), (2, 3, 1, 0)),
+        )
+        np.testing.assert_allclose(
+            loaded["constants"]["backbone"]["bn1"]["mean"],
+            sd["bn1.running_mean"].numpy(),
+        )
+
+        # forward actually uses the imported weights
+        out_init = model.apply(variables, x)
+        out_load = model.apply(
+            {"params": loaded["params"]["backbone"],
+             "constants": loaded["constants"]["backbone"]}, x,
+        )
+        assert not np.allclose(np.asarray(out_init[5]), np.asarray(out_load[5]))
+        assert np.isfinite(np.asarray(out_load[5])).all()
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        sd = _fake_torchvision_sd()
+        sd["conv1.weight"] = torch.zeros(64, 3, 3, 3)  # wrong kernel size
+        pth = str(tmp_path / "bad.pth")
+        torch.save(sd, pth)
+        model = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+        )
+        wrapped = {"params": {"backbone": variables["params"]},
+                   "constants": {"backbone": variables["constants"]}}
+        with pytest.raises(ValueError, match="shape mismatch"):
+            load_pretrained_backbone(wrapped, pth)
+
+    def test_resnet101_blocks(self):
+        sd = _fake_torchvision_sd(blocks=STAGE_BLOCKS["resnet101"])
+        params, _ = map_torch_resnet(sd)
+        assert "layer3_block22" in params
