@@ -106,6 +106,7 @@ class DetectionLoader:
         world: int = 1,
         with_masks: bool = False,
         prefetch: bool = True,
+        num_workers: Optional[int] = None,
     ) -> None:
         self.roidb = list(roidb[rank::world]) if world > 1 else list(roidb)
         self.cfg = cfg
@@ -114,6 +115,15 @@ class DetectionLoader:
         self.seed = seed
         self.with_masks = with_masks
         self.prefetch = prefetch and train
+        if num_workers is None:
+            # Scale with the host: decode+letterbox is ~15ms/image/core at
+            # 1024^2 while a v5e consumes ~2ms/image — TPU hosts have the
+            # cores; a 1-core CI box gets no pool (threads only add churn).
+            import os as _os
+
+            cores = _os.cpu_count() or 1
+            num_workers = min(8, cores) if cores > 1 else 0
+        self.num_workers = num_workers if train else 0
         if not self.roidb:
             raise ValueError("empty roidb shard")
 
@@ -209,7 +219,8 @@ class DetectionLoader:
 
     # -- iteration ---------------------------------------------------------
 
-    def _train_batches(self) -> Iterator[Batch]:
+    def _batch_specs(self):
+        """Infinite (records, flips) stream in epoch order."""
         epoch = 0
         rng = np.random.RandomState(self.seed + 17)
         while True:
@@ -219,8 +230,30 @@ class DetectionLoader:
                 flips = [
                     self.cfg.flip and bool(rng.randint(2)) for _ in recs
                 ]
-                yield self._assemble(recs, flips)
+                yield recs, flips
             epoch += 1
+
+    def _train_batches(self) -> Iterator[Batch]:
+        specs = self._batch_specs()
+        if self.num_workers <= 1:
+            for recs, flips in specs:
+                yield self._assemble(recs, flips)
+            return
+        # Worker pool assembling num_workers batches ahead, yielded in
+        # order.  Decode/resize/normalize release the GIL (cv2 and the C++
+        # letterbox kernel), so threads give real parallelism — the TPU
+        # step is ~2ms/image while host assembly is ~5-10ms/image.
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            pending = collections.deque(
+                pool.submit(self._assemble, *next(specs))
+                for _ in range(self.num_workers)
+            )
+            while True:
+                pending.append(pool.submit(self._assemble, *next(specs)))
+                yield pending.popleft().result()
 
     def _eval_batches(self):
         n = len(self.roidb)
