@@ -40,12 +40,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def _eval_loader(cfg: Config, with_masks: bool = False):
+def _eval_loader(cfg: Config, batch_size: int = 1, with_masks: bool = False):
     from mx_rcnn_tpu.data import DetectionLoader, build_dataset
 
     roidb = build_dataset(cfg.data, train=False).roidb()
     loader = DetectionLoader(
-        roidb, cfg.data, batch_size=1, train=False, with_masks=with_masks
+        roidb, cfg.data, batch_size=batch_size, train=False,
+        with_masks=with_masks,
     )
     return roidb, loader
 
@@ -72,14 +73,26 @@ def run_eval(
 
     from mx_rcnn_tpu.detection import TwoStageDetector
     from mx_rcnn_tpu.evalutil import pred_eval
+    from mx_rcnn_tpu.parallel import make_mesh
     from mx_rcnn_tpu.parallel.step import eval_variables, make_eval_step
 
     if state is None:
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
     model = TwoStageDetector(cfg=cfg.model)
-    eval_step = make_eval_step(model)
-    roidb, loader = _eval_loader(cfg)
+    # All visible chips evaluate in data parallel: one image per chip per
+    # step (the reference's test path is strictly single-device).  Gated to
+    # single-process runs: multi-host eval would need per-host roidb shards
+    # + global array assembly (shard_batch) and a cross-host metric merge.
+    mesh = (
+        make_mesh()
+        if jax.device_count() > 1 and jax.process_count() == 1
+        else None
+    )
+    eval_step = make_eval_step(model, mesh=mesh)
+    roidb, loader = _eval_loader(
+        cfg, batch_size=mesh.size if mesh is not None else 1
+    )
     style = "voc" if cfg.data.dataset == "voc" else "coco"
     class_names = None
     if cfg.data.dataset == "voc":

@@ -94,6 +94,10 @@ class RCNNConfig:
     # Class-agnostic box regression (False = per-class, reference default).
     class_agnostic: bool = False
     loss_weight: float = 1.0
+    # ROIAlign backend: "xla" (gather; default — in-graph it matches the
+    # kernel within noise once XLA fuses the step) or "pallas" (windowed
+    # DMA kernel, TPU only; see ops/pallas/roi_align.py measurements).
+    roi_align_impl: str = "xla"
 
 
 @dataclass(frozen=True)

@@ -69,7 +69,9 @@ def make_eval_step(model: TwoStageDetector, mesh: Optional[Mesh] = None):
     if mesh is None:
         return jax.jit(step)
     rep, data = replicated(mesh), batch_sharding(mesh)
-    return jax.jit(step, in_shardings=(rep, data), out_shardings=(data,))
+    # out_shardings is a single spec broadcast over the Detections pytree
+    # (a tuple here would be matched structurally and fail).
+    return jax.jit(step, in_shardings=(rep, data), out_shardings=data)
 
 
 def eval_variables(state: TrainState) -> dict:

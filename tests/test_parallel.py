@@ -88,3 +88,28 @@ class TestShardedTrainStep:
             jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
         )
         assert not np.allclose(w_before, w_after)
+
+
+class TestShardedEval:
+    def test_multichip_eval_matches_single(self, tmp_path):
+        """run_eval over the 8-device mesh == single-device metrics."""
+        import jax
+
+        from mx_rcnn_tpu.cli.eval_cli import run_eval
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.train.loop import build_all
+
+        cfg = get_config("tiny_synthetic", workdir=str(tmp_path))
+        _, _, state, _, _ = build_all(cfg, mesh=None)
+
+        multi = run_eval(cfg, state=state)
+
+        # Force the single-device path by hiding the mesh.
+        orig = jax.device_count
+        try:
+            jax.device_count = lambda *a, **k: 1
+            single = run_eval(cfg, state=state)
+        finally:
+            jax.device_count = orig
+        for k, v in single.items():
+            assert np.isclose(multi[k], v, atol=1e-5), (k, multi[k], v)
