@@ -33,9 +33,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def detect_image(cfg: Config, variables, image: np.ndarray):
+def detect_image(cfg: Config, variables, image: np.ndarray,
+                 mask_threshold: float = 0.0):
     """Run inference on one RGB uint8/float image; detections in original
-    image coordinates (the reference's ``im_detect`` + unscale)."""
+    image coordinates (the reference's ``im_detect`` + unscale).
+
+    Masks are pasted to image resolution only for detections scoring at
+    least ``mask_threshold`` (others get None — pasting is the expensive
+    part and the demo discards sub-threshold entries anyway)."""
     import jax
 
     from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
@@ -65,7 +70,16 @@ def detect_image(cfg: Config, variables, image: np.ndarray):
     boxes = np.asarray(dets.boxes[0])[valid] / scale
     boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, w - 1)
     boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, h - 1)
-    return boxes, np.asarray(dets.scores[0])[valid], np.asarray(dets.classes[0])[valid]
+    scores = np.asarray(dets.scores[0])[valid]
+    masks = None
+    if dets.masks is not None:
+        from mx_rcnn_tpu.evalutil.masks import paste_mask
+
+        masks = [
+            paste_mask(m, b, h, w) if s >= mask_threshold else None
+            for m, b, s in zip(np.asarray(dets.masks[0])[valid], boxes, scores)
+        ]
+    return boxes, scores, np.asarray(dets.classes[0])[valid], masks
 
 
 def draw_detections(
@@ -76,8 +90,10 @@ def draw_detections(
     class_names,
     out_path: str,
     threshold: float = 0.5,
+    masks=None,
 ) -> int:
-    """Matplotlib box overlay (vis_all_detection parity, saved not shown)."""
+    """Matplotlib box (+ instance mask) overlay — vis_all_detection parity,
+    saved not shown."""
     import matplotlib
 
     matplotlib.use("Agg")
@@ -88,10 +104,14 @@ def draw_detections(
     ax.axis("off")
     cmap = plt.get_cmap("hsv")
     shown = 0
-    for box, score, cls in zip(boxes, scores, classes):
+    for i, (box, score, cls) in enumerate(zip(boxes, scores, classes)):
         if score < threshold:
             continue
         color = cmap((int(cls) * 37 % 256) / 256.0)
+        if masks is not None and i < len(masks) and masks[i] is not None:
+            overlay = np.zeros((*masks[i].shape, 4), np.float32)
+            overlay[masks[i]] = (*color[:3], 0.4)
+            ax.imshow(overlay)
         x1, y1, x2, y2 = box
         ax.add_patch(
             plt.Rectangle((x1, y1), x2 - x1, y2 - y1, fill=False,
@@ -129,7 +149,9 @@ def main(argv=None):
 
         variables = eval_variables(jax.device_get(_restored_state(cfg, args.ckpt, args.step)))
 
-    boxes, scores, classes = detect_image(cfg, variables, image)
+    boxes, scores, classes, masks = detect_image(
+        cfg, variables, image, mask_threshold=args.threshold
+    )
     class_names = None
     if cfg.data.dataset == "voc":
         from mx_rcnn_tpu.data.datasets import VOC_CLASSES
@@ -141,10 +163,11 @@ def main(argv=None):
             log.info("%s %.3f [%.1f %.1f %.1f %.1f]", name, score, *box)
     out = args.out or (args.image.rsplit(".", 1)[0] + "_det.png")
     n = draw_detections(
-        image, boxes, scores, classes, class_names, out, args.threshold
+        image, boxes, scores, classes, class_names, out, args.threshold,
+        masks=masks,
     )
     log.info("drew %d detections -> %s", n, out)
-    return boxes, scores, classes
+    return boxes, scores, classes, masks
 
 
 if __name__ == "__main__":

@@ -111,7 +111,8 @@ class TestDriverRoundTrip:
             TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0), cfg.data.image_size
         )
         image = (np.random.RandomState(0).rand(100, 140, 3) * 255).astype(np.uint8)
-        boxes, scores, classes = detect_image(cfg, variables, image)
+        boxes, scores, classes, masks = detect_image(cfg, variables, image)
+        assert masks is None  # box-only config
         assert boxes.shape[1] == 4 and len(scores) == len(classes) == len(boxes)
         # boxes are in original-image coordinates.
         if len(boxes):
