@@ -66,20 +66,15 @@ def detect_image(cfg: Config, variables, image: np.ndarray,
     )
     infer = jax.jit(lambda v, b: forward_inference(model, v, b))
     dets = jax.device_get(infer(variables, batch))
-    valid = np.asarray(dets.valid[0])
-    boxes = np.asarray(dets.boxes[0])[valid] / scale
-    boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, w - 1)
-    boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, h - 1)
-    scores = np.asarray(dets.scores[0])[valid]
-    masks = None
-    if dets.masks is not None:
-        from mx_rcnn_tpu.evalutil.masks import paste_mask
+    from mx_rcnn_tpu.evalutil.postprocess import unletterbox_detections
 
-        masks = [
-            paste_mask(m, b, h, w) if s >= mask_threshold else None
-            for m, b, s in zip(np.asarray(dets.masks[0])[valid], boxes, scores)
-        ]
-    return boxes, scores, np.asarray(dets.classes[0])[valid], masks
+    d = unletterbox_detections(
+        dets.boxes[0], dets.scores[0], dets.classes[0], dets.valid[0],
+        scale, h, w,
+        masks=dets.masks[0] if dets.masks is not None else None,
+        mask_threshold=mask_threshold,
+    )
+    return d["boxes"], d["scores"], d["classes"], d.get("masks")
 
 
 def draw_detections(

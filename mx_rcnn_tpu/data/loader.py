@@ -233,8 +233,13 @@ class DetectionLoader:
                 yield recs, flips
             epoch += 1
 
-    def _train_batches(self) -> Iterator[Batch]:
+    def _train_batches(self, skip_batches: int = 0) -> Iterator[Batch]:
         specs = self._batch_specs()
+        # Resume fast-forward: spec generation (shuffle order + flip draws)
+        # is cheap; skipping specs instead of restarting keeps the resumed
+        # run on the same data schedule as an uninterrupted one.
+        for _ in range(skip_batches):
+            next(specs)
         if self.num_workers <= 1:
             for recs, flips in specs:
                 yield self._assemble(recs, flips)
@@ -265,9 +270,15 @@ class DetectionLoader:
             yield batch, recs
 
     def __iter__(self):
+        return self.iter_from()
+
+    def iter_from(self, skip_batches: int = 0):
+        """Iterate, skipping the first ``skip_batches`` training batches
+        (resume continuity: step k of a resumed run sees the batch step k
+        of an uninterrupted run would have)."""
         if not self.train:
             return self._eval_batches()
-        it = self._train_batches()
+        it = self._train_batches(skip_batches)
         if not self.prefetch:
             return it
         return _prefetched(it, depth=2)

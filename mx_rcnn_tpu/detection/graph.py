@@ -216,20 +216,22 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
             f"got {cfg.rcnn.roi_align_impl!r}"
         )
     levels = sorted(feats)
-    if len(levels) > 1:
-        roi_levels = {l: f for l, f in feats.items() if l in roi_level_set}
-        want_pallas = cfg.rcnn.roi_align_impl == "pallas"
-        can_pallas = (
-            jax.default_backend() == "tpu" and pallas_supported(roi_levels)
-        )
-        if want_pallas and not can_pallas:
-            import logging
+    want_pallas = cfg.rcnn.roi_align_impl == "pallas"
+    roi_levels = {l: f for l, f in feats.items() if l in roi_level_set}
+    can_pallas = (
+        len(levels) > 1
+        and jax.default_backend() == "tpu"
+        and pallas_supported(roi_levels)
+    )
+    if want_pallas and not can_pallas:
+        import logging
 
-            logging.getLogger("mx_rcnn_tpu").warning(
-                "roi_align_impl='pallas' requested but unavailable "
-                "(backend=%s, sliceable=%s) — using the XLA path",
-                jax.default_backend(), pallas_supported(roi_levels),
-            )
+        logging.getLogger("mx_rcnn_tpu").warning(
+            "roi_align_impl='pallas' requested but unavailable "
+            "(levels=%d, backend=%s) — using the XLA path",
+            len(levels), jax.default_backend(),
+        )
+    if len(levels) > 1:
         if want_pallas and can_pallas:
             per_image = [
                 multilevel_roi_align_fast(

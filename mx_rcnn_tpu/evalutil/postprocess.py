@@ -1,0 +1,59 @@
+"""Per-image detection un-letterboxing shared by eval and demo.
+
+One implementation of the "device detections → original image frame"
+contract (the reference's ``im_detect`` tail: ``/ im_scale`` + clip): the
+valid-mask filter, box unscaling, clipping to the original extents, and
+instance-mask paste-back.  Masks are pasted from the UNCLIPPED boxes —
+the M×M mask grid spans the full box, so pasting into a border-clipped
+extent would squash it; ``paste_mask`` crops at the canvas edge instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def unletterbox_detections(
+    boxes: np.ndarray,      # (D, 4) canvas coords
+    scores: np.ndarray,     # (D,)
+    classes: np.ndarray,    # (D,)
+    valid: np.ndarray,      # (D,) bool
+    scale: float,
+    height: int,
+    width: int,
+    masks: Optional[np.ndarray] = None,   # (D, M, M) probabilities
+    mask_threshold: float = 0.0,
+    encode_rle: bool = False,
+) -> dict:
+    """→ {"boxes", "scores", "classes"[, "masks"]} in original image coords.
+
+    Output boxes are clipped to the image; masks (when present) are pasted
+    at full unclipped extent, one entry per kept detection — binary (h, w)
+    arrays, or RLE dicts with ``encode_rle`` (None for detections under
+    ``mask_threshold`` unless encoding for evaluation, which keeps every
+    entry so indexes stay aligned).
+    """
+    valid = np.asarray(valid)
+    raw = np.asarray(boxes)[valid] / scale
+    clipped = raw.copy()
+    clipped[:, [0, 2]] = clipped[:, [0, 2]].clip(0, width - 1)
+    clipped[:, [1, 3]] = clipped[:, [1, 3]].clip(0, height - 1)
+    out = {
+        "boxes": clipped,
+        "scores": np.asarray(scores)[valid],
+        "classes": np.asarray(classes)[valid],
+    }
+    if masks is not None:
+        from mx_rcnn_tpu.evalutil.masks import paste_mask, rle_encode
+
+        pasted = []
+        for m, b, s in zip(np.asarray(masks)[valid], raw, out["scores"]):
+            if not encode_rle and s < mask_threshold:
+                pasted.append(None)
+                continue
+            full = paste_mask(m, b, height, width)
+            pasted.append(rle_encode(full) if encode_rle else full)
+        out["masks"] = pasted
+    return out

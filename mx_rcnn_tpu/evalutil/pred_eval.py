@@ -27,31 +27,19 @@ def collect_detections(
     progress: Optional[Callable[[int], None]] = None,
 ) -> dict[str, dict]:
     """Run inference over the loader; → image_id → original-coord results."""
+    from mx_rcnn_tpu.evalutil.postprocess import unletterbox_detections
+
     out: dict[str, dict] = {}
     done = 0
     for batch, recs in loader:
         dets = jax.device_get(eval_step(variables, jax.tree_util.tree_map(np.asarray, batch)))
         for i, rec in enumerate(recs):
-            scale = loader.record_scale(rec)
-            valid = np.asarray(dets.valid[i])
-            boxes = np.asarray(dets.boxes[i])[valid] / scale
-            # Clip to original extents (letterbox canvas may exceed them).
-            boxes[:, [0, 2]] = boxes[:, [0, 2]].clip(0, rec.width - 1)
-            boxes[:, [1, 3]] = boxes[:, [1, 3]].clip(0, rec.height - 1)
-            result = {
-                "boxes": boxes,
-                "scores": np.asarray(dets.scores[i])[valid],
-                "classes": np.asarray(dets.classes[i])[valid],
-            }
-            if dets.masks is not None:
-                from mx_rcnn_tpu.evalutil.masks import paste_mask, rle_encode
-
-                probs = np.asarray(dets.masks[i])[valid]
-                result["masks"] = [
-                    rle_encode(paste_mask(m, b, rec.height, rec.width))
-                    for m, b in zip(probs, boxes)
-                ]
-            out[rec.image_id] = result
+            out[rec.image_id] = unletterbox_detections(
+                dets.boxes[i], dets.scores[i], dets.classes[i], dets.valid[i],
+                loader.record_scale(rec), rec.height, rec.width,
+                masks=dets.masks[i] if dets.masks is not None else None,
+                encode_rle=True,
+            )
             done += 1
             if progress:
                 progress(done)

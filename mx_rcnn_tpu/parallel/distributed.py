@@ -69,11 +69,25 @@ def initialize(
     )
     if not explicit and not tpu_pod:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=n,
-        process_id=pid,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=n,
+            process_id=pid,
+        )
+    except ValueError as e:
+        # Only the stale-marker case is benign: autodetect couldn't even
+        # derive a coordinator address (single-chip dev boxes carry garbage
+        # TPU env markers).  Anything else — a real pod whose coordinator
+        # is unreachable, wrong counts — must fail loudly; swallowing it
+        # would split-brain the job into N independent "process 0" runs.
+        if explicit or "coordinator_address" not in str(e):
+            raise
+        log.warning(
+            "TPU pod markers present but no coordinator address could be "
+            "derived (%s); continuing single-process", e,
+        )
+        return
     log.info(
         "distributed runtime up: process %d/%d, %d local + %d global devices",
         jax.process_index(), jax.process_count(),

@@ -151,8 +151,9 @@ def train(
         )
     # Device prefetch: the host->device copy of batch k+1 overlaps batch
     # k's step (12MB/image at 1024^2 — unhidden it costs more than the
-    # fwd+bwd compute on a v5e).
-    it = device_prefetch(iter(loader), mesh, depth=2)
+    # fwd+bwd compute on a v5e).  Resumed runs fast-forward the loader so
+    # the data schedule matches an uninterrupted run.
+    it = device_prefetch(loader.iter_from(skip_batches=start), mesh, depth=2)
     profiler = ProfileWindow(profile_dir, *profile_steps)
     for i in range(start, steps):
         profiler.step(i, sync=state.params)

@@ -153,3 +153,54 @@ class TestBuild:
         variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
         feats = m.apply(variables, jnp.zeros((1, 32, 32, 3)))
         assert 4 in feats
+
+
+@pytest.mark.slow
+class TestVggTrainPath:
+    def test_vgg16_c4_train_step(self):
+        """BASELINE config #1's model family: one full train forward+grad
+        on a small canvas (the vgg path is otherwise only built, not run)."""
+        import dataclasses
+
+        import jax
+        import numpy as np
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_train, init_detector
+
+        cfg = get_config("vgg16_voc07")
+        model_cfg = dataclasses.replace(
+            cfg.model,
+            backbone=dataclasses.replace(
+                cfg.model.backbone, dtype="float32", freeze_stages=0
+            ),
+            rpn=dataclasses.replace(
+                cfg.model.rpn, train_pre_nms_top_n=100, train_post_nms_top_n=32
+            ),
+            rcnn=dataclasses.replace(
+                cfg.model.rcnn, roi_batch_size=16, hidden_dim=64
+            ),
+        )
+        model = TwoStageDetector(cfg=model_cfg)
+        size = (128, 128)
+        variables = init_detector(model, jax.random.PRNGKey(0), size)
+        g = 4
+        batch = Batch(
+            images=np.random.RandomState(0).rand(1, *size, 3).astype(np.float32),
+            image_hw=np.full((1, 2), 128.0, np.float32),
+            gt_boxes=np.array([[[10, 10, 60, 60], [70, 70, 120, 120],
+                                [0, 0, 0, 0], [0, 0, 0, 0]]], np.float32),
+            gt_classes=np.array([[1, 2, 0, 0]], np.int32),
+            gt_valid=np.array([[True, True, False, False]]),
+        )
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: forward_train(
+                model, {**variables, "params": p}, jax.random.PRNGKey(1), batch
+            ),
+            has_aux=True,
+        )(variables["params"])
+        assert np.isfinite(float(loss))
+        g_norm = sum(
+            float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(grads)
+        )
+        assert np.isfinite(g_norm) and g_norm > 0

@@ -120,3 +120,45 @@ class TestWeightDecayMask:
         assert np.all(np.asarray(updates["layer"]["kernel"]) != 0)
         np.testing.assert_allclose(updates["layer"]["bias"], 0)
         np.testing.assert_allclose(updates["layer"]["scale"], 0)
+
+
+@pytest.mark.slow
+class TestResumeContinuity:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        """train(6) == train(3) + resume(3): same data schedule, same step
+        count, same final loss scale (bitwise params equality also holds
+        because optimizer state incl. momentum is checkpointed)."""
+        import dataclasses
+
+        import jax
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.train.loop import train
+
+        def cfg_for(workdir):
+            cfg = get_config("tiny_synthetic", workdir=str(workdir))
+            sched = dataclasses.replace(
+                cfg.train.schedule, total_steps=6, warmup_steps=2,
+                decay_steps=(6,),
+            )
+            return dataclasses.replace(
+                cfg,
+                train=dataclasses.replace(
+                    cfg.train, schedule=sched, checkpoint_every=3, log_every=10
+                ),
+            )
+
+        cfg_a = cfg_for(tmp_path / "a")
+        full = train(cfg_a, mesh=None, total_steps=6, workdir=cfg_a.workdir)
+
+        cfg_b = cfg_for(tmp_path / "b")
+        train(cfg_b, mesh=None, total_steps=3, workdir=cfg_b.workdir)
+        resumed = train(
+            cfg_b, mesh=None, total_steps=6, workdir=cfg_b.workdir, resume=True
+        )
+
+        assert int(full.step) == int(resumed.step) == 6
+        la = jax.tree_util.tree_leaves(jax.device_get(full.params))
+        lb = jax.tree_util.tree_leaves(jax.device_get(resumed.params))
+        for a, b in zip(la, lb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
