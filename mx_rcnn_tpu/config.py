@@ -168,6 +168,11 @@ class ScheduleConfig:
 @dataclass(frozen=True)
 class TrainConfig:
     per_device_batch: int = 1  # reference: 1 image per GPU
+    # Chips per image sharing the spatial (height) axis — the mesh's model
+    # axis.  1 = pure data parallelism (reference parity).  >1 partitions
+    # the backbone convs spatially (XLA halo exchange) for resolutions one
+    # chip can't hold; devices must be divisible by it.
+    spatial_partition: int = 1
     momentum: float = 0.9
     weight_decay: float = 1e-4
     grad_clip: float = 35.0  # reference: clip_gradient=5 per-example scale

@@ -76,12 +76,17 @@ def initialize(
             process_id=pid,
         )
     except ValueError as e:
-        # Only the stale-marker case is benign: autodetect couldn't even
-        # derive a coordinator address (single-chip dev boxes carry garbage
-        # TPU env markers).  Anything else — a real pod whose coordinator
-        # is unreachable, wrong counts — must fail loudly; swallowing it
-        # would split-brain the job into N independent "process 0" runs.
-        if explicit or "coordinator_address" not in str(e):
+        # Only the stale-marker case is benign: a dev box carrying garbage
+        # TPU env markers that don't actually name multiple worker hosts.
+        # On anything that looks like a real pod (several hostnames in
+        # TPU_WORKER_HOSTNAMES) every failure must stay fatal: swallowing
+        # it would split-brain the job into N independent "process 0" runs
+        # clobbering one shared workdir.
+        hosts = [
+            h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+            if h.strip()
+        ]
+        if explicit or len(hosts) > 1 or "coordinator_address" not in str(e):
             raise
         log.warning(
             "TPU pod markers present but no coordinator address could be "

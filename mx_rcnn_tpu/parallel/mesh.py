@@ -41,6 +41,18 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def spatial_sharding(mesh: Mesh) -> NamedSharding:
+    """Images (B, H, W, C): batch over data, height over the model axis.
+
+    The CNN analog of sequence/context parallelism: convolutions over a
+    spatially-sharded tensor are partitioned by XLA's SPMD pass with
+    automatic halo exchange over ICI at stage boundaries — the detector's
+    "long context" story (train resolutions whose activations exceed one
+    chip's HBM), replacing nothing in the reference (it has no such mode).
+    """
+    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

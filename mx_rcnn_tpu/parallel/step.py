@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from mx_rcnn_tpu.detection.detector import TwoStageDetector
 from mx_rcnn_tpu.detection.graph import Batch, forward_inference, forward_train
-from mx_rcnn_tpu.parallel.mesh import batch_sharding, replicated
+from mx_rcnn_tpu.parallel.mesh import batch_sharding, replicated, spatial_sharding
 from mx_rcnn_tpu.train.state import TrainState, state_variables
 
 
@@ -26,6 +26,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     schedule=None,
     mesh: Optional[Mesh] = None,
+    spatial: bool = False,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -33,9 +34,23 @@ def make_train_step(
     gradient all-reduce is implicit in XLA's SPMD partitioning (grads of
     replicated params w.r.t. a sharded batch).  Without: plain single-device
     jit.  State buffers are donated — params update in place in HBM.
+
+    ``spatial``: additionally shard the image height over the mesh's model
+    axis (parallel/mesh.py::spatial_sharding) — XLA partitions the
+    backbone convs with halo exchange; the detection head's flatten/top-k
+    ops re-gather where profitable (XLA's choice).
     """
+    spatial_spec = (
+        spatial_sharding(mesh) if spatial and mesh is not None else None
+    )
 
     def step(state: TrainState, batch: Batch):
+        if spatial_spec is not None:
+            batch = batch._replace(
+                images=jax.lax.with_sharding_constraint(
+                    batch.images, spatial_spec
+                )
+            )
         rng = jax.random.fold_in(state.rng, state.step)
 
         def loss_fn(params):

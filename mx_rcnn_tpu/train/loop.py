@@ -26,6 +26,7 @@ from mx_rcnn_tpu.parallel import (
     make_train_step,
     replicated,
 )
+from mx_rcnn_tpu.parallel.mesh import MODEL_AXIS
 from mx_rcnn_tpu.train.checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from mx_rcnn_tpu.train.metrics import (
     ScalarWriter,
@@ -58,7 +59,22 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     model = TwoStageDetector(cfg=cfg.model)
     rng = jax.random.PRNGKey(cfg.train.seed)
     n_dev = mesh.size if mesh is not None else 1
-    global_batch = cfg.train.per_device_batch * n_dev
+    sp = cfg.train.spatial_partition
+    if sp > 1:
+        if mesh is None:
+            raise ValueError(
+                f"spatial_partition={sp} needs a device mesh "
+                "(single-device runs cannot shard the height axis)"
+            )
+        if mesh.shape[MODEL_AXIS] != sp:
+            raise ValueError(
+                f"mesh model axis is {mesh.shape[MODEL_AXIS]} but "
+                f"spatial_partition={sp}; build the mesh with "
+                f"make_mesh(model_parallel={sp})"
+            )
+    # With spatial partitioning, `sp` chips cooperate on each image: the
+    # data axis shrinks by sp, and so does the global batch.
+    global_batch = cfg.train.per_device_batch * (n_dev // sp)
     lr_scale = global_batch / 16.0
     freeze = ()
     if freeze_backbone and cfg.model.backbone.freeze_stages > 0:
@@ -84,7 +100,7 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
         state = state.replace(opt_state=tx.init(state.params))
     else:
         tx = probe_tx
-    step_fn = make_train_step(model, tx, schedule, mesh=mesh)
+    step_fn = make_train_step(model, tx, schedule, mesh=mesh, spatial=sp > 1)
     return model, tx, state, step_fn, global_batch
 
 
@@ -106,7 +122,7 @@ def train(
     phase (alternate training), ``resume`` to restore from workdir;
     ``profile_dir`` traces steps ``profile_steps`` into it (jax.profiler)."""
     if mesh is None and jax.device_count() > 1:
-        mesh = make_mesh()
+        mesh = make_mesh(model_parallel=cfg.train.spatial_partition)
     model, tx, fresh_state, step_fn, global_batch = build_all(
         cfg, mesh, extra_freeze=extra_freeze, pretrained=pretrained
     )
