@@ -124,7 +124,11 @@ def main(argv=None):
 
     from mx_rcnn_tpu.parallel import make_mesh
 
-    mesh = make_mesh() if jax.device_count() > 1 else None
+    mesh = (
+        make_mesh(model_parallel=cfg.train.spatial_partition)
+        if jax.device_count() > 1
+        else None
+    )
     state = alternate_train(
         cfg,
         mesh=mesh,

@@ -49,7 +49,11 @@ def main(argv=None) -> dict:
     from mx_rcnn_tpu.train.loop import train
 
     initialize()  # multi-host runtime (no-op single-process)
-    mesh = make_mesh() if jax.device_count() > 1 else None
+    mesh = (
+        make_mesh(model_parallel=cfg.train.spatial_partition)
+        if jax.device_count() > 1
+        else None
+    )
     n_dev = mesh.size if mesh is not None else 1
     log.info(
         "config=%s devices=%d backend=%s", cfg.name, n_dev, jax.default_backend()

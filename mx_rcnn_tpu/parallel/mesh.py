@@ -57,18 +57,31 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, spatial: bool = False):
     """Place a host batch onto the mesh, batch dim over the data axis.
+
+    ``spatial``: images additionally shard their height over the model
+    axis (each device receives only its slice — no replicate-then-slice).
 
     Single-process: a plain device_put with the named sharding.
     Multi-process: each host holds its local slice of the global batch and
     jax assembles the global array (the per-host input sharding the
     reference gets from per-worker KVStore ranks).
     """
-    sharding = batch_sharding(mesh)
+    data = batch_sharding(mesh)
+    img = spatial_sharding(mesh) if spatial else data
+
+    def spec_for(path):
+        name = getattr(path[-1], "name", None) if path else None
+        return img if name == "images" else data
+
     if jax.process_count() == 1:
-        return jax.device_put(batch, sharding)
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: jax.device_put(x, spec_for(p)), batch
+        )
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: jax.make_array_from_process_local_data(
+            spec_for(p), np.asarray(x)
+        ),
         batch,
     )

@@ -17,7 +17,8 @@ from mx_rcnn_tpu.parallel.mesh import shard_batch
 
 
 def device_prefetch(
-    it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2
+    it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2,
+    spatial: bool = False,
 ) -> Iterator:
     """Wrap a host batch iterator: batches come out device-resident (sharded
     over the mesh when given), ``depth`` transfers ahead of consumption."""
@@ -25,7 +26,7 @@ def device_prefetch(
 
     def put(batch):
         if mesh is not None:
-            return shard_batch(batch, mesh)
+            return shard_batch(batch, mesh, spatial=spatial)
         return jax.device_put(batch)
 
     for batch in it:

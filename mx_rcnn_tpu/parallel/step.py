@@ -67,9 +67,17 @@ def make_train_step(
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,))
     rep, data = replicated(mesh), batch_sharding(mesh)
+    # Per-field batch shardings (a pytree prefix): images may be spatially
+    # sharded; a prefix leaf over Batch's optional None fields applies to
+    # zero leaves, which is fine.
+    batch_shardings = Batch(
+        images=spatial_spec if spatial_spec is not None else data,
+        image_hw=data, gt_boxes=data, gt_classes=data, gt_valid=data,
+        gt_masks=data,
+    )
     return jax.jit(
         step,
-        in_shardings=(rep, data),
+        in_shardings=(rep, batch_shardings),
         out_shardings=(rep, rep),
         donate_argnums=(0,),
     )

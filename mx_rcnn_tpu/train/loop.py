@@ -165,11 +165,22 @@ def train(
         writer = ScalarWriter(
             f"{workdir}/{cfg.name}/metrics.jsonl", resume=start > 0
         )
+        # Reproducibility: the exact resolved config next to its artifacts
+        # (the reference leaves hyperparameters scattered across argparse
+        # defaults, the global config, and shell scripts).
+        import dataclasses as _dc
+        import json as _json
+
+        with open(f"{workdir}/{cfg.name}/config.json", "w") as f:
+            _json.dump(_dc.asdict(cfg), f, indent=1)
     # Device prefetch: the host->device copy of batch k+1 overlaps batch
     # k's step (12MB/image at 1024^2 — unhidden it costs more than the
     # fwd+bwd compute on a v5e).  Resumed runs fast-forward the loader so
     # the data schedule matches an uninterrupted run.
-    it = device_prefetch(loader.iter_from(skip_batches=start), mesh, depth=2)
+    it = device_prefetch(
+        loader.iter_from(skip_batches=start), mesh, depth=2,
+        spatial=cfg.train.spatial_partition > 1,
+    )
     profiler = ProfileWindow(profile_dir, *profile_steps)
     for i in range(start, steps):
         profiler.step(i, sync=state.params)

@@ -145,7 +145,9 @@ class TestSpatialPartition:
             batch = next(iter(loader))
             if mesh is not None:
                 state = jax.device_put(state, replicated(mesh))
-                batch = shard_batch(batch, mesh)
+                batch = shard_batch(
+                    batch, mesh, spatial=c.train.spatial_partition > 1
+                )
             state, metrics = step_fn(state, batch)
             return {k: float(v) for k, v in jax.device_get(metrics).items()}, gb
 

@@ -1,0 +1,87 @@
+"""Property-based tests (hypothesis) for the geometry/NMS invariants.
+
+SURVEY.md §5(b): random-input properties the reference never checked —
+encode/decode round trips, NMS postconditions, clip idempotence — over
+adversarial box configurations hypothesis finds (degenerate, coincident,
+huge, tiny).
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.geometry import clip_boxes, decode_boxes, encode_boxes, iou_matrix
+from mx_rcnn_tpu.ops.nms import nms_mask
+
+
+def boxes_strategy(n_max=32, extent=500.0):
+    @st.composite
+    def _boxes(draw):
+        n = draw(st.integers(1, n_max))
+        x1 = draw(
+            st.lists(st.floats(0, extent, width=32), min_size=n, max_size=n)
+        )
+        y1 = draw(
+            st.lists(st.floats(0, extent, width=32), min_size=n, max_size=n)
+        )
+        w = draw(
+            st.lists(st.floats(0.5, extent, width=32), min_size=n, max_size=n)
+        )
+        h = draw(
+            st.lists(st.floats(0.5, extent, width=32), min_size=n, max_size=n)
+        )
+        x1, y1, w, h = map(np.asarray, (x1, y1, w, h))
+        return np.stack([x1, y1, x1 + w, y1 + h], axis=1).astype(np.float32)
+
+    return _boxes()
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes_strategy())
+def test_encode_decode_roundtrip(boxes):
+    """decode(encode(b, anchors), anchors) == b for any valid boxes."""
+    rng = np.random.RandomState(0)
+    anchors = boxes + rng.uniform(-5, 5, boxes.shape).astype(np.float32)
+    anchors[:, 2:] = np.maximum(anchors[:, 2:], anchors[:, :2] + 1.0)
+    deltas = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors))
+    back = decode_boxes(deltas, jnp.asarray(anchors))
+    np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-3, atol=1e-2)
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes_strategy())
+def test_iou_bounds_and_symmetry(boxes):
+    iou = np.asarray(iou_matrix(jnp.asarray(boxes), jnp.asarray(boxes)))
+    assert (iou >= 0).all() and (iou <= 1 + 1e-6).all()
+    np.testing.assert_allclose(iou, iou.T, atol=1e-6)
+    # a non-degenerate box overlaps itself fully
+    assert np.allclose(np.diag(iou), 1.0, atol=1e-5)
+
+
+@settings(max_examples=25, deadline=None)
+@given(boxes_strategy(n_max=24), st.floats(0.1, 0.9))
+def test_nms_postconditions(boxes, thresh):
+    """No two kept boxes overlap above the threshold, and every suppressed
+    box overlaps some higher-scoring kept box above it."""
+    n = len(boxes)
+    scores = jnp.asarray(np.linspace(1.0, 0.1, n, dtype=np.float32))
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), scores, float(thresh)))
+    iou = np.asarray(iou_matrix(jnp.asarray(boxes), jnp.asarray(boxes)))
+    kept = np.flatnonzero(keep)
+    for a_i in range(len(kept)):
+        for b_i in range(a_i + 1, len(kept)):
+            assert iou[kept[a_i], kept[b_i]] <= thresh + 1e-5
+    for i in np.flatnonzero(~keep):
+        higher = [j in kept for j in range(i) if iou[j, i] > thresh]
+        assert any(higher), f"box {i} suppressed by nothing"
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes_strategy(extent=800.0), st.integers(50, 600), st.integers(50, 600))
+def test_clip_idempotent_and_bounded(boxes, h, w):
+    c1 = np.asarray(clip_boxes(jnp.asarray(boxes), float(h), float(w)))
+    c2 = np.asarray(clip_boxes(jnp.asarray(c1), float(h), float(w)))
+    np.testing.assert_allclose(c1, c2)
+    assert (c1[:, [0, 2]] <= w).all() and (c1[:, [1, 3]] <= h).all()
+    assert (c1 >= 0).all()
