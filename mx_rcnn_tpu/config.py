@@ -46,6 +46,11 @@ class BackboneConfig:
     norm: str = "frozen_bn"  # frozen_bn | bn | gn
     # Compute dtype for conv/matmul (params stay float32).
     dtype: str = "bfloat16"
+    # Rematerialize backbone activations on the backward pass
+    # (jax.checkpoint per residual block / conv group): trades ~1/3 more
+    # backbone FLOPs for O(depth) less HBM — enables bigger canvases or
+    # per-chip batches than stored activations would allow.
+    remat: bool = False
 
 
 @dataclass(frozen=True)

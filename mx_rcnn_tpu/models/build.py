@@ -16,7 +16,7 @@ def build_backbone(cfg: BackboneConfig, out_levels: tuple[int, ...] = (2, 3, 4, 
     dtype = _DTYPES[cfg.dtype]
     if cfg.name in STAGE_BLOCKS:
         return ResNet(blocks=STAGE_BLOCKS[cfg.name], norm=cfg.norm, dtype=dtype,
-                      out_levels=out_levels, name="backbone")
+                      out_levels=out_levels, remat=cfg.remat, name="backbone")
     if cfg.name == "vgg16":
-        return VGG16(dtype=dtype, name="backbone")
+        return VGG16(dtype=dtype, remat=cfg.remat, name="backbone")
     raise ValueError(f"unknown backbone {cfg.name!r}")

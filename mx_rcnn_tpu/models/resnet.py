@@ -66,9 +66,16 @@ class ResNet(nn.Module):
     norm: str = "frozen_bn"
     dtype: jnp.dtype = jnp.bfloat16
     out_levels: tuple[int, ...] = (2, 3, 4, 5)
+    # Checkpoint each bottleneck: its activations are recomputed during the
+    # backward pass instead of living in HBM across it.  The stage outputs
+    # (the pyramid) are still saved, so FPN/heads see no recompute.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> dict[int, jnp.ndarray]:
+        block_cls = (
+            nn.remat(Bottleneck, prevent_cse=False) if self.remat else Bottleneck
+        )
         x = x.astype(self.dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, name="conv1")(x)
@@ -81,7 +88,7 @@ class ResNet(nn.Module):
         for i, (n_blocks, width) in enumerate(zip(self.blocks, widths)):
             stride = 1 if i == 0 else 2
             for b in range(n_blocks):
-                x = Bottleneck(
+                x = block_cls(
                     channels=width,
                     stride=stride if b == 0 else 1,
                     norm=self.norm,

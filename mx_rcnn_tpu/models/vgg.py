@@ -16,18 +16,40 @@ from flax import linen as nn
 VGG16_GROUPS = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
 
 
+class _ConvGroup(nn.Module):
+    """One VGG group: n_convs 3x3 convs + relu (pooling stays outside)."""
+
+    group: int
+    channels: int
+    n_convs: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for c in range(self.n_convs):
+            x = nn.Conv(
+                self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                dtype=self.dtype, name=f"conv{self.group}_{c + 1}",
+            )(x)
+            x = nn.relu(x)
+        return x
+
+
 class VGG16(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
+    # Recompute each conv group's intermediates on the backward pass.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> dict[int, jnp.ndarray]:
+        group_cls = (
+            nn.remat(_ConvGroup, prevent_cse=False) if self.remat else _ConvGroup
+        )
         x = x.astype(self.dtype)
         feats: dict[int, jnp.ndarray] = {}
         for g, (ch, n_convs) in enumerate(VGG16_GROUPS):
-            for c in range(n_convs):
-                x = nn.Conv(ch, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype,
-                            name=f"conv{g + 1}_{c + 1}")(x)
-                x = nn.relu(x)
+            x = group_cls(group=g + 1, channels=ch, n_convs=n_convs,
+                          dtype=self.dtype, name=f"group{g + 1}")(x)
             if g < 4:  # no pool5 (reference keeps stride 16)
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             feats[g + 1] = x

@@ -44,7 +44,8 @@ log = logging.getLogger("mx_rcnn_tpu")
 FREEZE_PREFIXES = {
     "resnet50": ("conv1", "bn1", "layer1"),
     "resnet101": ("conv1", "bn1", "layer1"),
-    "vgg16": ("conv1", "conv2"),
+    # VGG groups 1-2 = conv1_x/conv2_x (reference: fixed conv1_/conv2_).
+    "vgg16": ("group1", "group2"),
 }
 
 

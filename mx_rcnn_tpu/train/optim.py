@@ -43,14 +43,17 @@ def make_schedule(cfg: ScheduleConfig, scale: float = 1.0) -> Callable:
 
 
 def frozen_mask(params, freeze_prefixes: tuple[str, ...]) -> dict:
-    """True = trainable. A param is frozen when any path component starts
-    with one of ``freeze_prefixes`` (reference: ``fixed_param_prefix``,
-    e.g. ('conv1', 'res2') / ('conv1_', 'conv2_'))."""
+    """True = trainable. A param is frozen when a MODULE-level path component
+    (the top-level module or its direct child — e.g. ``box_head`` or
+    ``backbone/layer1``) starts with one of ``freeze_prefixes`` (reference:
+    ``fixed_param_prefix``, e.g. ('conv1', 'res2') / ('conv1_', 'conv2_')).
+    Deeper components are NOT matched: ResNet bottlenecks have an inner
+    ``conv1`` that must stay trainable when the stem's ``conv1`` is frozen."""
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
 
     def trainable(path) -> bool:
-        for part in path:
+        for part in path[:2]:
             name = getattr(part, "key", None)
             if isinstance(name, str) and any(
                 name.startswith(p) for p in freeze_prefixes

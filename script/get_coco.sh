@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Fetch COCO 2017 into the layout CocoDataset expects (reference parity:
+# upstream ships dataset download helpers in script/).
+#
+#   data/
+#     annotations/instances_{train,val}2017.json
+#     train2017/*.jpg
+#     val2017/*.jpg
+#
+# Usage: script/get_coco.sh [DATA_ROOT]
+# Requires network access (this environment has none — run elsewhere and
+# mount, or point --set data.root at an existing COCO root).
+set -e
+ROOT="${1:-data}"
+mkdir -p "$ROOT"
+cd "$ROOT"
+
+fetch() {
+  url="$1"
+  f="$(basename "$url")"
+  # Resume partial downloads into the SAME file; only skip re-download once
+  # the archive verifies (a truncated zip would otherwise wedge every rerun).
+  if ! unzip -t -qq "$f" >/dev/null 2>&1; then
+    curl -fL -C - -o "$f" "$url" || wget -c -O "$f" "$url"
+    unzip -t -qq "$f" >/dev/null
+  fi
+  unzip -n -q "$f"
+}
+
+fetch http://images.cocodataset.org/annotations/annotations_trainval2017.zip
+fetch http://images.cocodataset.org/zips/val2017.zip
+fetch http://images.cocodataset.org/zips/train2017.zip
+echo "COCO2017 ready under $ROOT (use --set data.root=$ROOT)"

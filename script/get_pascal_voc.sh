@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Fetch PASCAL VOC 2007 (+ optionally 2012) into the layout VocDataset
+# expects (reference parity: the upstream repo ships dataset download
+# helpers alongside its training recipes in script/).
+#
+#   data/
+#     VOC2007/{Annotations,ImageSets,JPEGImages}
+#     VOC2012/{Annotations,ImageSets,JPEGImages}
+#
+# Usage: script/get_pascal_voc.sh [DATA_ROOT] [--with-2012]
+# Requires network access (this environment has none — run elsewhere and
+# mount, or point --set data.root at an existing VOCdevkit).
+set -e
+ROOT="${1:-data}"
+mkdir -p "$ROOT"
+cd "$ROOT"
+
+fetch() {
+  url="$1"
+  f="$(basename "$url")"
+  # Resume partial downloads into the SAME file; only skip re-download once
+  # the archive verifies (a truncated tar would otherwise wedge every rerun).
+  if ! tar tf "$f" >/dev/null 2>&1; then
+    curl -fL -C - -o "$f" "$url" || wget -c -O "$f" "$url"
+    tar tf "$f" >/dev/null
+  fi
+  tar xf "$f"
+}
+
+fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtrainval_06-Nov-2007.tar
+fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtest_06-Nov-2007.tar
+if [ "${2:-}" = "--with-2012" ]; then
+  fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2012/VOCtrainval_11-May-2012.tar
+fi
+
+# The tars unpack to VOCdevkit/VOC20xx; flatten to ROOT/VOC20xx.
+for y in 2007 2012; do
+  [ -d "VOCdevkit/VOC$y" ] && mv -n "VOCdevkit/VOC$y" "VOC$y"
+done
+rmdir VOCdevkit 2>/dev/null || true
+echo "VOC ready under $ROOT (use --set data.root=$ROOT)"

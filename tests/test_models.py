@@ -155,6 +155,44 @@ class TestBuild:
         assert 4 in feats
 
 
+class TestRemat:
+    """backbone.remat recomputes activations on the backward pass; the
+    function (value AND gradient) must be unchanged."""
+
+    @pytest.mark.parametrize("name", ["resnet50", "vgg16"])
+    def test_same_outputs_and_grads(self, name):
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 32, 32, 3), jnp.float32)
+
+        def build(remat):
+            m = build_backbone(
+                BackboneConfig(name=name, dtype="float32", remat=remat),
+                out_levels=(4,),
+            )
+            variables = m.init(jax.random.PRNGKey(0), x)
+            return m, variables
+
+        m0, v0 = build(False)
+        m1, v1 = build(True)
+        # Identical param trees (remat must not rename/restructure params).
+        p0 = jax.tree_util.tree_flatten_with_path(v0["params"])[0]
+        p1 = jax.tree_util.tree_flatten_with_path(v1["params"])[0]
+        assert [k for k, _ in p0] == [k for k, _ in p1]
+
+        def loss(m, v):
+            return lambda p: jnp.sum(
+                m.apply({**v, "params": p}, x)[4].astype(jnp.float32) ** 2
+            )
+
+        y0, g0 = jax.value_and_grad(loss(m0, v0))(v0["params"])
+        y1, g1 = jax.value_and_grad(loss(m1, v1))(v1["params"])
+        np.testing.assert_allclose(float(y0), float(y1), rtol=1e-5)
+        for (k, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g0)[0],
+            jax.tree_util.tree_flatten_with_path(g1)[0],
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=str(k))
+
+
 @pytest.mark.slow
 class TestVggTrainPath:
     def test_vgg16_c4_train_step(self):

@@ -50,6 +50,36 @@ class TestFrozenMask:
         assert mask["backbone"]["res3"]["kernel"] is True
         assert mask["rpn"]["conv"]["kernel"] is True
 
+    def test_deep_components_not_matched(self):
+        """Freezing the stem's conv1 must NOT freeze the bottleneck-internal
+        conv1 living deeper in the tree (backbone/layerN_blockM/conv1)."""
+        params = {
+            "backbone": {
+                "conv1": {"kernel": jnp.ones(3)},
+                "layer2_block0": {"conv1": {"kernel": jnp.ones(3)}},
+            }
+        }
+        mask = frozen_mask(params, ("conv1", "bn1", "layer1"))
+        assert mask["backbone"]["conv1"]["kernel"] is False
+        assert mask["backbone"]["layer2_block0"]["conv1"]["kernel"] is True
+
+    def test_resnet50_freeze_set_matches_reference(self):
+        """On the real R50 tree, conv1+bn1+layer1 freezes exactly the stem
+        and stage-1 params (reference fixed_param_prefix), nothing more."""
+        from mx_rcnn_tpu.config import BackboneConfig
+        from mx_rcnn_tpu.models.build import build_backbone
+
+        m = build_backbone(BackboneConfig(name="resnet50", dtype="float32"),
+                           out_levels=(4,))
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        params = {"backbone": variables["params"]}
+        mask = frozen_mask(params, ("conv1", "bn1", "layer1"))
+        flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+        for path, trainable in flat:
+            stage = path[1].key  # component under "backbone"
+            frozen_expected = stage in ("conv1", "bn1") or stage.startswith("layer1_")
+            assert trainable is (not frozen_expected), jax.tree_util.keystr(path)
+
     def test_masked_optimizer_keeps_frozen(self):
         params = {"frozen_w": jnp.ones(4), "free_w": jnp.ones(4)}
         cfg = TrainConfig(schedule=ScheduleConfig(base_lr=0.1, warmup_steps=1))
