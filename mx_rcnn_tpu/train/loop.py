@@ -42,10 +42,10 @@ log = logging.getLogger("mx_rcnn_tpu")
 # fixed_param_prefix equivalents per backbone (reference: conv1/res2 frozen
 # for ResNet, conv1_/conv2_ for VGG — train_end2end.py arg defaults).
 FREEZE_PREFIXES = {
-    "resnet50": ("conv1", "bn1", "layer1"),
-    "resnet101": ("conv1", "bn1", "layer1"),
+    "resnet50": ("backbone/conv1", "backbone/bn1", "backbone/layer1"),
+    "resnet101": ("backbone/conv1", "backbone/bn1", "backbone/layer1"),
     # VGG groups 1-2 = conv1_x/conv2_x (reference: fixed conv1_/conv2_).
-    "vgg16": ("group1", "group2"),
+    "vgg16": ("backbone/group1", "backbone/group2"),
 }
 
 

@@ -43,20 +43,27 @@ def make_schedule(cfg: ScheduleConfig, scale: float = 1.0) -> Callable:
 
 
 def frozen_mask(params, freeze_prefixes: tuple[str, ...]) -> dict:
-    """True = trainable. A param is frozen when a MODULE-level path component
-    (the top-level module or its direct child — e.g. ``box_head`` or
-    ``backbone/layer1``) starts with one of ``freeze_prefixes`` (reference:
+    """True = trainable.  Each freeze prefix is a ``/``-separated module
+    path anchored at the tree root, its last component matched as a string
+    prefix: ``"box_head"`` freezes the whole box head, ``"backbone/layer1"``
+    freezes every ``backbone/layer1_block*`` (reference:
     ``fixed_param_prefix``, e.g. ('conv1', 'res2') / ('conv1_', 'conv2_')).
-    Deeper components are NOT matched: ResNet bottlenecks have an inner
-    ``conv1`` that must stay trainable when the stem's ``conv1`` is frozen."""
+    Anchoring is what keeps same-named inner modules trainable — ResNet
+    bottlenecks and the mask head both contain a ``conv1`` that must NOT be
+    caught by freezing the backbone stem's ``backbone/conv1``."""
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    prefixes = [p.split("/") for p in freeze_prefixes]
 
     def trainable(path) -> bool:
-        for part in path[:2]:
-            name = getattr(part, "key", None)
-            if isinstance(name, str) and any(
-                name.startswith(p) for p in freeze_prefixes
+        names = [getattr(part, "key", None) for part in path]
+        for parts in prefixes:
+            if len(names) < len(parts):
+                continue
+            head, last = parts[:-1], parts[-1]
+            if all(isinstance(n, str) for n in names[: len(parts)]) and (
+                names[: len(head)] == head
+                and names[len(head)].startswith(last)
             ):
                 return False
         return True

@@ -17,7 +17,11 @@ cd "$ROOT"
 
 fetch() {
   url="$1"
+  year="$2"
   f="$(basename "$url")"
+  # Already flattened into ROOT/VOC20xx on a previous run — nothing to do
+  # (re-extracting would leave a duplicate tree under VOCdevkit/).
+  [ -d "VOC$year" ] && return 0
   # Resume partial downloads into the SAME file; only skip re-download once
   # the archive verifies (a truncated tar would otherwise wedge every rerun).
   if ! tar tf "$f" >/dev/null 2>&1; then
@@ -27,10 +31,10 @@ fetch() {
   tar xf "$f"
 }
 
-fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtrainval_06-Nov-2007.tar
-fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtest_06-Nov-2007.tar
+fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtrainval_06-Nov-2007.tar 2007
+fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2007/VOCtest_06-Nov-2007.tar 2007
 if [ "${2:-}" = "--with-2012" ]; then
-  fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2012/VOCtrainval_11-May-2012.tar
+  fetch http://host.robots.ox.ac.uk/pascal/VOC/voc2012/VOCtrainval_11-May-2012.tar 2012
 fi
 
 # The tars unpack to VOCdevkit/VOC20xx; flatten to ROOT/VOC20xx.

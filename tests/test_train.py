@@ -45,23 +45,28 @@ class TestFrozenMask:
             "backbone": {"conv1": {"kernel": jnp.ones(3)}, "res3": {"kernel": jnp.ones(3)}},
             "rpn": {"conv": {"kernel": jnp.ones(3)}},
         }
-        mask = frozen_mask(params, ("conv1",))
+        mask = frozen_mask(params, ("backbone/conv1",))
         assert mask["backbone"]["conv1"]["kernel"] is False
         assert mask["backbone"]["res3"]["kernel"] is True
         assert mask["rpn"]["conv"]["kernel"] is True
 
     def test_deep_components_not_matched(self):
-        """Freezing the stem's conv1 must NOT freeze the bottleneck-internal
-        conv1 living deeper in the tree (backbone/layerN_blockM/conv1)."""
+        """Freezing the stem's backbone/conv1 must NOT freeze same-named
+        modules elsewhere: the bottleneck-internal conv1
+        (backbone/layerN_blockM/conv1) or the mask head's conv1."""
         params = {
             "backbone": {
                 "conv1": {"kernel": jnp.ones(3)},
                 "layer2_block0": {"conv1": {"kernel": jnp.ones(3)}},
-            }
+            },
+            "mask_head": {"conv1": {"kernel": jnp.ones(3)}},
         }
-        mask = frozen_mask(params, ("conv1", "bn1", "layer1"))
+        mask = frozen_mask(
+            params, ("backbone/conv1", "backbone/bn1", "backbone/layer1")
+        )
         assert mask["backbone"]["conv1"]["kernel"] is False
         assert mask["backbone"]["layer2_block0"]["conv1"]["kernel"] is True
+        assert mask["mask_head"]["conv1"]["kernel"] is True
 
     def test_resnet50_freeze_set_matches_reference(self):
         """On the real R50 tree, conv1+bn1+layer1 freezes exactly the stem
@@ -73,7 +78,9 @@ class TestFrozenMask:
                            out_levels=(4,))
         variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
         params = {"backbone": variables["params"]}
-        mask = frozen_mask(params, ("conv1", "bn1", "layer1"))
+        mask = frozen_mask(
+            params, ("backbone/conv1", "backbone/bn1", "backbone/layer1")
+        )
         flat = jax.tree_util.tree_flatten_with_path(mask)[0]
         for path, trainable in flat:
             stage = path[1].key  # component under "backbone"
