@@ -70,26 +70,36 @@ def main() -> None:
     # the input pipeline overlaps transfers in the real loop
     # (parallel/prefetch.py) and is benchmarked by its own tests.
     data = jax.device_put(data)
+
+    def sync(s, m):
+        # Under the axon tunnel block_until_ready returns at dispatch time,
+        # not execution time — a device->host fetch of a value that depends
+        # on the whole step is the only true barrier.  One fetch per timed
+        # window (amortized over the dependency-chained steps), so the
+        # tunnel round-trip is counted once, not per step.
+        jax.device_get(m["loss"])
+
     # Warmup (compile) + timed steps.
     for _ in range(3):
         state, metrics = step_fn(state, data)
-    jax.block_until_ready(state.params)
-    n_steps = 20 if on_accel else 5
+    sync(state, metrics)
+    n_steps = 30 if on_accel else 5
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step_fn(state, data)
-    jax.block_until_ready(state.params)
+    sync(state, metrics)
     dt = time.perf_counter() - t0
 
-    # Per-step percentiles (sync per step — counts dispatch) on stderr.
+    # Per-step percentiles (sync per step — includes one tunnel round-trip
+    # per step, an upper bound) on stderr.
     from mx_rcnn_tpu.utils import StepTimer
 
     timer = StepTimer(warmup=2)
     for _ in range(8 if on_accel else 3):
         with timer:
             state, metrics = step_fn(state, data)
-            jax.block_until_ready(state.params)
-    print(f"per-step (synced): {timer.summary()}", file=sys.stderr)
+            sync(state, metrics)
+    print(f"per-step (synced upper bound): {timer.summary()}", file=sys.stderr)
 
     img_s = n_steps * batch / dt
     print(
