@@ -74,10 +74,12 @@ def main() -> None:
     def sync(s, m):
         # Under the axon tunnel block_until_ready returns at dispatch time,
         # not execution time — a device->host fetch of a value that depends
-        # on the whole step is the only true barrier.  One fetch per timed
-        # window (amortized over the dependency-chained steps), so the
-        # tunnel round-trip is counted once, not per step.
-        jax.device_get(m["loss"])
+        # on the whole step is the only true barrier.  Fetch from the
+        # UPDATED params (depends on forward+backward+optimizer) and the
+        # loss; one fetch per timed window, so the tunnel round-trip is
+        # counted once, not per step.
+        leaf = jax.tree_util.tree_leaves(s.params)[0]
+        jax.device_get((m["loss"], leaf.ravel()[0]))
 
     # Warmup (compile) + timed steps.
     for _ in range(3):

@@ -73,42 +73,20 @@ def _bilinear_gather(flat, h, w, sy, sx):
     """Bilinear sample at (sy (R,S), sx (R,S)) -> (R, S, S, C).
 
     Out-of-range samples (beyond one pixel outside the map, matching
-    Detectron ROIAlign semantics) contribute zero.
+    Detectron ROIAlign semantics) contribute zero.  The single-map case of
+    ``_bilinear_gather_flat`` with constant per-roi extents.
     """
-    inside = (
-        (sy[:, :, None] > -1.0)
-        & (sy[:, :, None] < h)
-        & (sx[:, None, :] > -1.0)
-        & (sx[:, None, :] < w)
-    )  # (R, S, S)
-
-    y = jnp.clip(sy, 0.0, h - 1)  # (R, S)
-    x = jnp.clip(sx, 0.0, w - 1)
-    y0 = jnp.floor(y)
-    x0 = jnp.floor(x)
-    ly = y - y0  # (R, S)
-    lx = x - x0
-    y0i = y0.astype(jnp.int32)
-    x0i = x0.astype(jnp.int32)
-    y1i = jnp.minimum(y0i + 1, h - 1)
-    x1i = jnp.minimum(x0i + 1, w - 1)
-
-    def gather(yi, xi):  # yi (R,S), xi (R,S) -> (R, S, S, C)
-        idx = yi[:, :, None] * w + xi[:, None, :]  # (R, S, S)
-        return jnp.take(flat, idx.reshape(-1), axis=0).reshape(*idx.shape, -1)
-
-    wy0 = (1.0 - ly)[:, :, None, None]
-    wy1 = ly[:, :, None, None]
-    wx0 = (1.0 - lx)[:, None, :, None]
-    wx1 = lx[:, None, :, None]
-
-    val = (
-        gather(y0i, x0i) * wy0 * wx0
-        + gather(y0i, x1i) * wy0 * wx1
-        + gather(y1i, x0i) * wy1 * wx0
-        + gather(y1i, x1i) * wy1 * wx1
+    r = sy.shape[0]
+    ones = jnp.ones((r,), jnp.float32)
+    return _bilinear_gather_flat(
+        flat,
+        h * ones,
+        w * ones,
+        jnp.full((r,), w, jnp.int32),
+        jnp.zeros((r,), jnp.int32),
+        sy,
+        sx,
     )
-    return val * inside[..., None]
 
 
 # Default bound on a roi's extent in feature cells at its assigned level.
@@ -153,12 +131,116 @@ def multilevel_roi_align(
     """ROIAlign over an FPN pyramid with per-roi level assignment.
 
     ``feature_pyramid`` maps level -> (H_l, W_l, C); stride of level l is
-    2**l.  Every roi is pooled from every level and the per-roi one-hot
-    level indicator selects the result — 4x redundant compute but fully
-    static shapes and no host interaction; the Pallas kernel
-    (ops/pallas/roi_align.py) gathers per-level instead and is the
-    performance path on TPU.
+    2**l.  The levels are flattened and concatenated into ONE (sum H_l*W_l,
+    C) buffer and each roi gathers through a per-roi base offset into it —
+    one bilinear gather pass total (and one scatter-add in the backward),
+    versus pooling every roi at every level and masking (4x the gather and
+    scatter volume; kept as ``_multilevel_roi_align_dense``, the oracle).
+    All shapes static, no host interaction.
     """
+    levels = sorted(feature_pyramid.keys())
+    c = feature_pyramid[levels[0]].shape[-1]
+    flat = jnp.concatenate(
+        [feature_pyramid[l].reshape(-1, c) for l in levels], axis=0
+    )
+    hs, ws, bases = [], [], []
+    off = 0
+    for l in levels:
+        h, w, _ = feature_pyramid[l].shape
+        hs.append(h)
+        ws.append(w)
+        bases.append(off)
+        off += h * w
+    hs = jnp.asarray(hs, jnp.float32)
+    ws_f = jnp.asarray(ws, jnp.float32)
+    ws_i = jnp.asarray(ws, jnp.int32)
+    bases = jnp.asarray(bases, jnp.int32)
+
+    assignment = fpn_level_assignment(
+        rois, min_level=levels[0], max_level=levels[-1],
+        max_extent_cells=max_extent_cells,
+    )
+    li = assignment - levels[0]                       # (R,) index into arrays
+    scale = 2.0 ** (-assignment.astype(jnp.float32))  # (R,) 1/stride per roi
+    h_r = jnp.take(hs, li)                            # (R,) float
+    w_r = jnp.take(ws_f, li)
+    wi_r = jnp.take(ws_i, li)                         # (R,) int row pitch
+    base_r = jnp.take(bases, li)                      # (R,) int
+
+    scaled = rois * scale[:, None]
+    x1, y1 = scaled[:, 0], scaled[:, 1]
+    rw = jnp.maximum(scaled[:, 2] - x1, 1.0)
+    rh = jnp.maximum(scaled[:, 3] - y1, 1.0)
+    bin_w = rw / output_size
+    bin_h = rh / output_size
+    bins = jnp.arange(output_size, dtype=jnp.float32)
+
+    out = jnp.zeros((rois.shape[0], output_size, output_size, c), jnp.float32)
+    for iy in range(sampling_ratio):
+        fy = (iy + 0.5) / sampling_ratio
+        sy = y1[:, None] + (bins[None, :] + fy) * bin_h[:, None]  # (R, S)
+        for ix in range(sampling_ratio):
+            fx = (ix + 0.5) / sampling_ratio
+            sx = x1[:, None] + (bins[None, :] + fx) * bin_w[:, None]
+            out = out + _bilinear_gather_flat(
+                flat, h_r, w_r, wi_r, base_r, sy, sx
+            )
+    return (out / (sampling_ratio * sampling_ratio)).astype(flat.dtype)
+
+
+def _bilinear_gather_flat(flat, h_r, w_r, wi_r, base_r, sy, sx):
+    """Per-roi-extent bilinear sample into a concatenated pyramid buffer.
+
+    Same semantics as ``_bilinear_gather`` with the map bounds (h_r, w_r),
+    row pitch (wi_r) and flat-index base (base_r) varying per roi.
+    """
+    inside = (
+        (sy[:, :, None] > -1.0)
+        & (sy[:, :, None] < h_r[:, None, None])
+        & (sx[:, None, :] > -1.0)
+        & (sx[:, None, :] < w_r[:, None, None])
+    )  # (R, S, S)
+
+    y = jnp.clip(sy, 0.0, h_r[:, None] - 1)  # (R, S)
+    x = jnp.clip(sx, 0.0, w_r[:, None] - 1)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    ly = y - y0
+    lx = x - x0
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+    y1i = jnp.minimum(y0i + 1, h_r[:, None].astype(jnp.int32) - 1)
+    x1i = jnp.minimum(x0i + 1, w_r[:, None].astype(jnp.int32) - 1)
+
+    def gather(yi, xi):  # yi (R,S), xi (R,S) -> (R, S, S, C)
+        idx = base_r[:, None, None] + yi[:, :, None] * wi_r[:, None, None] + xi[:, None, :]
+        return jnp.take(flat, idx.reshape(-1), axis=0).reshape(*idx.shape, -1)
+
+    wy0 = (1.0 - ly)[:, :, None, None]
+    wy1 = ly[:, :, None, None]
+    wx0 = (1.0 - lx)[:, None, :, None]
+    wx1 = lx[:, None, :, None]
+
+    val = (
+        gather(y0i, x0i) * wy0 * wx0
+        + gather(y0i, x1i) * wy0 * wx1
+        + gather(y1i, x0i) * wy1 * wx0
+        + gather(y1i, x1i) * wy1 * wx1
+    )
+    return val * inside[..., None]
+
+
+def _multilevel_roi_align_dense(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+    max_extent_cells: int | None = MAX_EXTENT_CELLS,
+) -> jnp.ndarray:
+    """Oracle: pool every roi at every level, mask-select by assignment.
+
+    4x the gather volume of ``multilevel_roi_align`` — kept for tests (the
+    two must agree exactly) and as the reference semantics."""
     levels = sorted(feature_pyramid.keys())
     assignment = fpn_level_assignment(
         rois, min_level=levels[0], max_level=levels[-1],

@@ -158,6 +158,51 @@ def test_multilevel_roi_align_selects_level(rng):
     np.testing.assert_allclose(out[2], 5.0)
 
 
+def test_multilevel_flat_matches_dense(rng):
+    """The flattened-pyramid single-gather path must equal the dense
+    pool-every-level oracle — values AND gradients — including
+    out-of-bounds and degenerate rois."""
+    from mx_rcnn_tpu.ops.roi_align import _multilevel_roi_align_dense
+
+    canvas = 256
+    pyramid = {
+        l: jnp.asarray(
+            rng.rand(canvas // 2**l, canvas // 2**l, 8).astype(np.float32)
+        )
+        for l in (2, 3, 4, 5)
+    }
+    r = 64
+    x1 = rng.uniform(-30, canvas, r)
+    y1 = rng.uniform(-30, canvas, r)
+    bw = rng.uniform(0, canvas, r)
+    bh = rng.uniform(0, canvas, r)
+    rois = np.stack([x1, y1, x1 + bw, y1 + bh], axis=1).astype(np.float32)
+    rois[0] = [10, 10, 10, 10]          # degenerate
+    rois[1] = [0, 0, 0, 0]              # zero (padding)
+    rois[2] = [-50, -50, -10, -10]      # fully outside
+    rois = jnp.asarray(rois)
+
+    got = multilevel_roi_align(pyramid, rois, output_size=7, sampling_ratio=2)
+    want = _multilevel_roi_align_dense(
+        pyramid, rois, output_size=7, sampling_ratio=2
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def loss_flat(pyr):
+        return jnp.sum(multilevel_roi_align(pyr, rois, 7, 2) ** 2)
+
+    def loss_dense(pyr):
+        return jnp.sum(_multilevel_roi_align_dense(pyr, rois, 7, 2) ** 2)
+
+    g_flat = jax.grad(loss_flat)(pyramid)
+    g_dense = jax.grad(loss_dense)(pyramid)
+    for l in pyramid:
+        np.testing.assert_allclose(
+            np.asarray(g_flat[l]), np.asarray(g_dense[l]),
+            rtol=1e-4, atol=1e-5, err_msg=f"level {l}",
+        )
+
+
 # ---------------- proposals ----------------
 
 

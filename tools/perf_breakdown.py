@@ -1,0 +1,185 @@
+"""Ablation timing of the train step's sub-graphs on the real chip.
+
+Times successively larger prefixes of the full train computation
+(backbone -> +RPN head -> +anchor assignment/RPN losses -> +proposals ->
++sampling+ROIAlign -> full step) so hotspots can be localized without a
+device profiler (the axon tunnel exposes no trace).  Every timing is N
+queued executions ended by ONE device->host fetch — see BASELINE.md's
+timing-method warning: block_until_ready returns at dispatch under the
+tunnel; the fetch of the last result waits on the whole queue.
+
+Usage: python tools/perf_breakdown.py [--hw 1024] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, arg, n):
+    """Time n dependency-chained executions of ``fn`` (a grad of params).
+
+    Each iteration perturbs the argument with 0 * a leaf of the previous
+    output, so execution i+1 provably depends on execution i and the single
+    final fetch waits for the whole chain (BASELINE.md timing rule — queue
+    order alone is not a trusted synchronization under the axon tunnel).
+    """
+    out = fn(arg)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # compile+sync
+
+    eps = jax.jit(
+        lambda a, o: jax.tree_util.tree_map(lambda x, g: x + 0.0 * g, a, o)
+    )
+    carry = arg
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(carry)
+        carry = eps(carry, out)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hw", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--config", default="r50_fpn_coco")
+    ap.add_argument(
+        "--set", dest="overrides", action="append", default=[],
+        metavar="KEY.PATH=VALUE",
+    )
+    args = ap.parse_args()
+
+    from mx_rcnn_tpu.config import apply_overrides, get_config
+    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_train
+    from mx_rcnn_tpu.detection.graph import (
+        _pool_rois,
+        _propose_one,
+        _rpn_losses,
+        _slice_levels,
+        assign_anchors_cfg,
+        init_detector,
+        level_anchors,
+    )
+    from mx_rcnn_tpu.ops import sample_rois
+
+    hw = args.hw
+    cfg = get_config(args.config)
+    cfg = apply_overrides(
+        cfg,
+        [f"data.image_size=({hw},{hw})", "data.max_gt_boxes=32"]
+        + args.overrides,
+    )
+    model = TwoStageDetector(cfg=cfg.model)
+    variables = init_detector(model, jax.random.PRNGKey(0), (hw, hw))
+    params = variables["params"]
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    rng = np.random.RandomState(0)
+    g = cfg.data.max_gt_boxes
+    boxes = np.zeros((1, g, 4), np.float32)
+    boxes[:, :8] = [100.0, 100.0, 300.0, 300.0]
+    batch = Batch(
+        images=jnp.asarray(rng.randn(1, hw, hw, 3), jnp.float32),
+        image_hw=jnp.full((1, 2), float(hw), jnp.float32),
+        gt_boxes=jnp.asarray(boxes),
+        gt_classes=jnp.ones((1, g), jnp.int32),
+        gt_valid=jnp.asarray(np.arange(g)[None] < 8),
+    )
+    key = jax.random.PRNGKey(1)
+    mcfg = cfg.model
+
+    # Shared front end (mirrors forward_train's structure).  Each stage is
+    # "everything before it" + one more piece; all stages keep the RPN loss
+    # term so the backbone backward exists in every variant (in the real
+    # graph proposals/sampling are stop-grad side computations).
+    def front(p, upto: str):
+        v = {"params": p, **rest}
+        feats = model.apply(v, batch.images, method="features")
+        if upto == "backbone":
+            return sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in feats.values())
+        rpn_out = model.apply(v, feats, method="rpn")
+        anchors = level_anchors(mcfg, feats)
+        levels = sorted(rpn_out)
+        logits = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)
+        deltas = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)
+        if upto == "rpn":
+            return sum(
+                jnp.sum(o.astype(jnp.float32) ** 2)
+                for pair in rpn_out.values() for o in pair
+            )
+        anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)
+        targets = jax.vmap(
+            lambda k, gt, gv, hw_: assign_anchors_cfg(
+                mcfg, k, anchors_cat, gt, gv, hw_[0], hw_[1]
+            )
+        )(key[None].repeat(1, 0), batch.gt_boxes, batch.gt_valid, batch.image_hw)
+        rpn_cls, rpn_box, _ = _rpn_losses(logits, deltas, targets)
+        loss = rpn_cls + rpn_box
+        if upto == "rpnloss":
+            return loss
+        scores = jax.nn.sigmoid(jax.lax.stop_gradient(logits))
+        propose = _propose_one(mcfg, train=True)
+        props = jax.vmap(
+            lambda s, d, hw_: propose(*_slice_levels(levels, anchors, s, d), hw_)
+        )(scores, jax.lax.stop_gradient(deltas), batch.image_hw)
+        if upto == "proposals":
+            return loss + (jnp.sum(props.rois) + jnp.sum(props.scores)) * 1e-30
+        samples = jax.vmap(
+            lambda k, rois, rv, gt, gc, gv: sample_rois(
+                k, rois, rv, gt, gc, gv,
+                batch_size=mcfg.rcnn.roi_batch_size,
+                fg_fraction=mcfg.rcnn.fg_fraction,
+                fg_iou=mcfg.rcnn.fg_iou,
+                bg_iou_hi=mcfg.rcnn.bg_iou_hi,
+                bg_iou_lo=mcfg.rcnn.bg_iou_lo,
+                bbox_weights=mcfg.rcnn.bbox_weights,
+            )
+        )(key[None].repeat(1, 0), props.rois, props.valid, batch.gt_boxes,
+          batch.gt_classes, batch.gt_valid)
+        if upto == "sample":
+            return loss + jnp.sum(samples.rois) * 1e-30
+        pooled = _pool_rois(
+            mcfg, feats, samples.rois, mcfg.rcnn.pooled_size, model.roi_levels
+        )
+        if upto == "pool":
+            return loss + jnp.sum(pooled.astype(jnp.float32) ** 2) * 1e-30
+        raise ValueError(upto)
+
+    def stage_full(p):
+        loss, _ = forward_train(model, {"params": p, **rest}, key, batch)
+        return loss
+
+    stages = [
+        ("backbone fwd+bwd", lambda p: front(p, "backbone")),
+        ("+rpn head", lambda p: front(p, "rpn")),
+        ("+assign+rpn losses", lambda p: front(p, "rpnloss")),
+        ("+proposal gen (stop-grad)", lambda p: front(p, "proposals")),
+        ("+sample_rois (stop-grad)", lambda p: front(p, "sample")),
+        ("+roialign (stop-grad)", lambda p: front(p, "pool")),
+        ("full forward_train+bwd", stage_full),
+    ]
+    results = []
+    for name, fn in stages:
+        grad = jax.jit(jax.grad(fn))
+        dt = timed(grad, params, args.steps)
+        results.append((name, dt))
+        print(f"{name:32s} {dt * 1e3:8.2f} ms/step", flush=True)
+    print("\ndeltas vs previous stage:")
+    prev = None
+    for name, dt in results:
+        print(f"{name:32s} +{(dt - (prev if prev is not None else dt)) * 1e3:7.2f} ms")
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()
