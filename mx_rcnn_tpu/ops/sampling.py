@@ -29,13 +29,37 @@ def _random_rank(key: jax.Array, candidate: jnp.ndarray) -> jnp.ndarray:
     """Rank of each element among candidates under a random permutation.
 
     Non-candidates rank after all candidates.  rank is 0-based: selecting
-    ``rank < n`` picks n uniform-random candidates.
+    ``rank < n`` picks n uniform-random candidates.  O(N log N) sort plus an
+    O(N) scatter — fine at proposal scale (N ~ 2k in :func:`sample_rois`);
+    use :func:`_select_random` for anchor-scale N (~262k at 1024x1024),
+    where the full sort + scatter dominate the whole assignment.
     """
     pri = jax.random.uniform(key, candidate.shape)
     pri = jnp.where(candidate, pri, 2.0)  # non-candidates sort last
     order = jnp.argsort(pri)
     ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
     return ranks
+
+
+def _select_random(
+    key: jax.Array, candidate: jnp.ndarray, n, quota: int
+) -> jnp.ndarray:
+    """Uniform-random boolean selection of ``n`` (traced, <= static
+    ``quota``) of the candidates.
+
+    top_k of random priorities over the ``quota`` best replaces the full
+    argsort-rank: the sort shrinks from O(N log N) to O(N log quota) and
+    the scatter from N-wide to quota-wide.  Exact — ties are broken inside
+    top_k by index, and exactly ``min(n, #candidates)`` entries come back
+    True (callers pass ``n <= #candidates``).
+    """
+    a = candidate.shape[0]
+    n = jnp.minimum(n, jnp.sum(candidate))  # total: never select non-candidates
+    pri = jax.random.uniform(key, (a,))
+    pri = jnp.where(candidate, pri, -1.0)  # non-candidates last under max
+    _, idx = jax.lax.top_k(pri, min(quota, a))  # quota most-prior candidates
+    take = jnp.arange(idx.shape[0]) < n
+    return jnp.zeros((a,), bool).at[idx].set(take)
 
 
 class AnchorTargets(NamedTuple):
@@ -100,13 +124,11 @@ def assign_anchors(
 
     num_fg_quota = int(batch_size * fg_fraction)
     k_fg, k_bg = jax.random.split(key)
-    fg_rank = _random_rank(k_fg, fg_cand)
     n_fg = jnp.minimum(num_fg_quota, jnp.sum(fg_cand))
-    fg = fg_cand & (fg_rank < n_fg)
+    fg = _select_random(k_fg, fg_cand, n_fg, num_fg_quota)
 
-    bg_rank = _random_rank(k_bg, bg_cand)
     n_bg = jnp.minimum(batch_size - n_fg, jnp.sum(bg_cand))
-    bg = bg_cand & (bg_rank < n_bg)
+    bg = _select_random(k_bg, bg_cand, n_bg, batch_size)
 
     labels = jnp.full((a,), -1, dtype=jnp.int32)
     labels = jnp.where(bg, 0, labels)

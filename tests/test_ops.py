@@ -254,6 +254,40 @@ def test_generate_proposals_all_in_one_jit(rng):
 # ---------------- assign_anchors ----------------
 
 
+def test_select_random_exact_and_uniform(rng):
+    from mx_rcnn_tpu.ops.sampling import _select_random
+
+    cand = jnp.asarray(rng.rand(1000) < 0.3)
+    n_cand = int(cand.sum())
+    # Exactly n selected, all candidates.
+    for n, quota in [(0, 64), (10, 64), (64, 64)]:
+        sel = _select_random(jax.random.key(0), cand, jnp.minimum(n, n_cand), quota)
+        assert int(sel.sum()) == min(n, n_cand)
+        assert bool(jnp.all(~sel | cand))
+    # Deterministic per key, different across keys.
+    s1 = _select_random(jax.random.key(1), cand, 32, 64)
+    s2 = _select_random(jax.random.key(1), cand, 32, 64)
+    s3 = _select_random(jax.random.key(2), cand, 32, 64)
+    assert bool(jnp.all(s1 == s2))
+    assert not bool(jnp.all(s1 == s3))
+    # Roughly uniform: over many keys every candidate gets picked sometimes.
+    counts = np.zeros(1000)
+    for k in range(200):
+        counts += np.asarray(
+            _select_random(jax.random.key(k), cand, 32, 64)
+        )
+    picked_rate = counts[np.asarray(cand)]
+    assert picked_rate.min() > 0  # no candidate starved over 200 draws
+
+    # Scarce-candidate regime: fewer candidates than quota — the top_k
+    # window then contains non-candidate slots, which must never be picked
+    # even when the requested n exceeds the candidate count.
+    scarce = jnp.zeros(1000, bool).at[jnp.asarray(rng.choice(1000, 20, False))].set(True)
+    sel = _select_random(jax.random.key(5), scarce, 64, 64)
+    assert int(sel.sum()) == 20
+    assert bool(jnp.all(~sel | scarce))
+
+
 def test_assign_anchors_basic(rng):
     base = generate_base_anchors(16, (0.5, 1.0, 2.0), (2, 4))
     anchors = shifted_anchors(jnp.asarray(base), 16, 12, 12)
