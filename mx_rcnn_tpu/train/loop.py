@@ -33,7 +33,7 @@ from mx_rcnn_tpu.train.metrics import (
     Speedometer,
     device_metrics_to_host,
 )
-from mx_rcnn_tpu.train.optim import make_optimizer
+from mx_rcnn_tpu.train.optim import frozen_mask, make_optimizer
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
 from mx_rcnn_tpu.utils import ProfileWindow
 
@@ -94,14 +94,20 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
             params=variables["params"],
             model_state={k: v for k, v in variables.items() if k != "params"},
         )
+    trainable = None
     if freeze:
         tx, schedule = make_optimizer(
             cfg.train, state.params, lr_scale=lr_scale, freeze_prefixes=freeze
         )
         state = state.replace(opt_state=tx.init(state.params))
+        # Same mask the optimizer uses: frozen leaves are stop-gradient'd
+        # inside the step so their backward is eliminated, not just zeroed.
+        trainable = frozen_mask(state.params, freeze)
     else:
         tx = probe_tx
-    step_fn = make_train_step(model, tx, schedule, mesh=mesh, spatial=sp > 1)
+    step_fn = make_train_step(
+        model, tx, schedule, mesh=mesh, spatial=sp > 1, trainable_mask=trainable
+    )
     return model, tx, state, step_fn, global_batch
 
 
