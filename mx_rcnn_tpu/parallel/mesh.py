@@ -36,12 +36,16 @@ def make_mesh(
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading (batch) dim split over the data axis, rest replicated."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+def batch_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
+    """Leading (batch) dim split over the data axis, rest replicated.
+
+    ``stacked``: the batch has a leading steps-per-call axis (K, B, ...)
+    that stays replicated; the batch axis is then dim 1.
+    """
+    return NamedSharding(mesh, P(None, DATA_AXIS) if stacked else P(DATA_AXIS))
 
 
-def spatial_sharding(mesh: Mesh) -> NamedSharding:
+def spatial_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
     """Images (B, H, W, C): batch over data, height over the model axis.
 
     The CNN analog of sequence/context parallelism: convolutions over a
@@ -50,26 +54,30 @@ def spatial_sharding(mesh: Mesh) -> NamedSharding:
     "long context" story (train resolutions whose activations exceed one
     chip's HBM), replacing nothing in the reference (it has no such mode).
     """
-    return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS))
+    spec = (
+        P(None, DATA_AXIS, MODEL_AXIS) if stacked else P(DATA_AXIS, MODEL_AXIS)
+    )
+    return NamedSharding(mesh, spec)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh, spatial: bool = False):
+def shard_batch(batch, mesh: Mesh, spatial: bool = False, stacked: bool = False):
     """Place a host batch onto the mesh, batch dim over the data axis.
 
     ``spatial``: images additionally shard their height over the model
     axis (each device receives only its slice — no replicate-then-slice).
+    ``stacked``: leaves carry a leading steps-per-call axis (K, B, ...).
 
     Single-process: a plain device_put with the named sharding.
     Multi-process: each host holds its local slice of the global batch and
     jax assembles the global array (the per-host input sharding the
     reference gets from per-worker KVStore ranks).
     """
-    data = batch_sharding(mesh)
-    img = spatial_sharding(mesh) if spatial else data
+    data = batch_sharding(mesh, stacked=stacked)
+    img = spatial_sharding(mesh, stacked=stacked) if spatial else data
 
     def spec_for(path):
         name = getattr(path[-1], "name", None) if path else None

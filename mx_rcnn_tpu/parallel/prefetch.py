@@ -18,15 +18,16 @@ from mx_rcnn_tpu.parallel.mesh import shard_batch
 
 def device_prefetch(
     it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2,
-    spatial: bool = False,
+    spatial: bool = False, stacked: bool = False,
 ) -> Iterator:
     """Wrap a host batch iterator: batches come out device-resident (sharded
-    over the mesh when given), ``depth`` transfers ahead of consumption."""
+    over the mesh when given), ``depth`` transfers ahead of consumption.
+    ``stacked``: batches carry a leading steps-per-call axis (K, B, ...)."""
     q: collections.deque = collections.deque()
 
     def put(batch):
         if mesh is not None:
-            return shard_batch(batch, mesh, spatial=spatial)
+            return shard_batch(batch, mesh, spatial=spatial, stacked=stacked)
         return jax.device_put(batch)
 
     for batch in it:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import time
+from typing import Optional
 
 import jax
 import numpy as np
@@ -48,13 +49,18 @@ class Speedometer:
         self.frequent = frequent
         self._acc = MetricAccumulator()
         self._tic = time.monotonic()
+        self._last_step: Optional[int] = None
 
     def __call__(self, step: int, metrics: dict) -> None:
+        """Log a line for this call.  The loop invokes this exactly at its
+        log points (which with steps_per_call>1 need not be multiples of
+        ``frequent``), so speed is computed from the actual step delta
+        since the previous call rather than assuming ``frequent`` steps."""
         self._acc.update(metrics)
-        if step % self.frequent != 0:
-            return
+        delta = self.frequent if self._last_step is None else step - self._last_step
+        self._last_step = step
         elapsed = time.monotonic() - self._tic
-        speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+        speed = max(delta, 1) * self.batch_size / max(elapsed, 1e-9)
         parts = ", ".join(f"{k}={v:.4f}" for k, v in self._acc.summary().items())
         log.info("step %d speed %.2f samples/sec %s", step, speed, parts)
         self._acc.reset()

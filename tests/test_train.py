@@ -199,3 +199,43 @@ class TestResumeContinuity:
         lb = jax.tree_util.tree_leaves(jax.device_get(resumed.params))
         for a, b in zip(la, lb):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+@pytest.mark.slow
+class TestStepsPerCall:
+    def test_scan_loop_matches_sequential(self, tmp_path):
+        """train.steps_per_call=2 (device-side lax.scan step loop) must
+        produce the same params as the per-step host loop on the same data
+        schedule."""
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.train.loop import train
+
+        def cfg_for(workdir, k):
+            cfg = get_config("tiny_synthetic")
+            sched = dataclasses.replace(
+                cfg.train.schedule, total_steps=4, warmup_steps=1, decay_steps=(3,)
+            )
+            return dataclasses.replace(
+                cfg,
+                workdir=str(workdir),
+                train=dataclasses.replace(
+                    cfg.train, schedule=sched, steps_per_call=k,
+                    checkpoint_every=100, log_every=2,
+                ),
+            )
+
+        cfg1 = cfg_for(tmp_path / "seq", 1)
+        seq = train(cfg1, mesh=None, total_steps=4, workdir=cfg1.workdir)
+        cfg2 = cfg_for(tmp_path / "scan", 2)
+        scanned = train(cfg2, mesh=None, total_steps=4, workdir=cfg2.workdir)
+
+        assert int(seq.step) == int(scanned.step) == 4
+        fa = jax.tree_util.tree_flatten_with_path(jax.device_get(seq.params))[0]
+        fb = dict(
+            jax.tree_util.tree_flatten_with_path(jax.device_get(scanned.params))[0]
+        )
+        for path, a in fa:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(fb[path]), atol=1e-5,
+                err_msg=jax.tree_util.keystr(path),
+            )
