@@ -14,6 +14,7 @@ import numpy as np
 
 from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.evalutil.vis import draw_detections  # noqa: F401 (re-export: CLI surface)
 
 log = logging.getLogger("mx_rcnn_tpu.demo")
 
@@ -77,48 +78,6 @@ def detect_image(cfg: Config, variables, image: np.ndarray,
     return d["boxes"], d["scores"], d["classes"], d.get("masks")
 
 
-def draw_detections(
-    image: np.ndarray,
-    boxes: np.ndarray,
-    scores: np.ndarray,
-    classes: np.ndarray,
-    class_names,
-    out_path: str,
-    threshold: float = 0.5,
-    masks=None,
-) -> int:
-    """Matplotlib box (+ instance mask) overlay — vis_all_detection parity,
-    saved not shown."""
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
-    fig, ax = plt.subplots(1, figsize=(12, 12 * image.shape[0] / max(image.shape[1], 1)))
-    ax.imshow(image.astype(np.uint8))
-    ax.axis("off")
-    cmap = plt.get_cmap("hsv")
-    shown = 0
-    for i, (box, score, cls) in enumerate(zip(boxes, scores, classes)):
-        if score < threshold:
-            continue
-        color = cmap((int(cls) * 37 % 256) / 256.0)
-        if masks is not None and i < len(masks) and masks[i] is not None:
-            overlay = np.zeros((*masks[i].shape, 4), np.float32)
-            overlay[masks[i]] = (*color[:3], 0.4)
-            ax.imshow(overlay)
-        x1, y1, x2, y2 = box
-        ax.add_patch(
-            plt.Rectangle((x1, y1), x2 - x1, y2 - y1, fill=False,
-                          edgecolor=color, linewidth=2)
-        )
-        name = class_names[int(cls)] if class_names else str(int(cls))
-        ax.text(x1, max(y1 - 3, 0), f"{name} {score:.2f}", fontsize=9,
-                color="white", bbox=dict(facecolor=color, alpha=0.7, pad=1))
-        shown += 1
-    fig.savefig(out_path, bbox_inches="tight", dpi=120)
-    plt.close(fig)
-    return shown
 
 
 def main(argv=None):

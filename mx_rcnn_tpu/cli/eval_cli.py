@@ -37,6 +37,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "--use-07-metric", action="store_true", help="VOC 11-point AP metric"
     )
+    p.add_argument(
+        "--vis", type=int, default=0, metavar="N",
+        help="draw the first N evaluated images with detections into "
+        "<workdir>/<config>/vis (reference pred_eval vis=True parity)",
+    )
     return p.parse_args(argv)
 
 
@@ -67,6 +72,7 @@ def run_eval(
     step: Optional[int] = None,
     dump_path: Optional[str] = None,
     use_07_metric: bool = False,
+    vis_count: int = 0,
 ) -> dict:
     """Evaluate a state (or a restored checkpoint) on the config's val split."""
     import jax
@@ -109,6 +115,8 @@ def run_eval(
         class_names=class_names,
         use_07_metric=use_07_metric,
         dump_path=dump_path,
+        vis_dir=f"{cfg.workdir}/{cfg.name}/vis" if vis_count > 0 else None,
+        vis_count=vis_count,
     )
     for k, v in sorted(metrics.items()):
         log.info("%s = %.4f", k, v)
@@ -179,6 +187,7 @@ def main(argv=None) -> dict:
         step=args.step,
         dump_path=args.dump,
         use_07_metric=args.use_07_metric,
+        vis_count=args.vis,
     )
 
 

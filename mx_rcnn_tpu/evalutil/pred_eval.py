@@ -111,6 +111,49 @@ def evaluate_detections(
     raise ValueError(f"unknown eval style {style!r}")
 
 
+def visualize_detections(
+    per_image: dict[str, dict],
+    roidb,
+    out_dir: str,
+    class_names: Optional[tuple] = None,
+    count: int = 10,
+    threshold: float = 0.5,
+) -> int:
+    """Draw the first ``count`` evaluated images with their detections
+    (reference ``pred_eval(vis=True)`` / ``vis_all_detection`` parity,
+    written to files instead of shown).  Returns images written."""
+    import os
+    import re
+
+    from mx_rcnn_tpu.data.loader import _load_image
+    from mx_rcnn_tpu.evalutil.masks import rle_decode
+    from mx_rcnn_tpu.evalutil.vis import draw_detections
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = 0
+    for rec in roidb:
+        if written >= count:
+            break
+        d = per_image.get(rec.image_id)
+        if d is None:
+            continue
+        image = _load_image(rec)
+        masks = None
+        if "masks" in d:
+            masks = [
+                rle_decode(m).astype(bool) if isinstance(m, dict) else m
+                for m in d["masks"]
+            ]
+        name = re.sub(r"[^A-Za-z0-9_.-]", "_", str(rec.image_id))
+        draw_detections(
+            image, d["boxes"], d["scores"], d["classes"], class_names,
+            os.path.join(out_dir, f"{name}.png"), threshold=threshold,
+            masks=masks,
+        )
+        written += 1
+    return written
+
+
 def pred_eval(
     eval_step: Callable,
     variables,
@@ -121,10 +164,21 @@ def pred_eval(
     class_names: Optional[tuple] = None,
     use_07_metric: bool = False,
     dump_path: Optional[str] = None,
+    vis_dir: Optional[str] = None,
+    vis_count: int = 10,
 ) -> dict[str, float]:
     per_image = collect_detections(eval_step, variables, loader)
     if dump_path:
         save_detections(dump_path, per_image)
+    if vis_dir:
+        n = visualize_detections(
+            per_image, roidb, vis_dir, class_names, count=vis_count
+        )
+        import logging
+
+        logging.getLogger("mx_rcnn_tpu").info(
+            "wrote %d visualization(s) to %s", n, vis_dir
+        )
     return evaluate_detections(
         per_image, roidb, num_classes, style, class_names, use_07_metric
     )

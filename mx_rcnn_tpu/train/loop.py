@@ -215,10 +215,12 @@ def train(
         spatial=cfg.train.spatial_partition > 1, stacked=k > 1,
     )
     # Quantize the profile window to the loop stride so it still opens
-    # when i advances k at a time.
+    # when i advances k at a time.  Round UP: the default (10, 15) window
+    # exists to skip the compile step, so the start must never be pulled
+    # back to 0.
     p0, p1 = profile_steps
-    p0 -= p0 % k
-    p1 = max(p1 - p1 % k, p0 + k)
+    p0 += -p0 % k
+    p1 = max(p1 + (-p1 % k), p0 + k)
     profiler = ProfileWindow(profile_dir, p0, p1)
     for i in range(start, steps, k):
         profiler.step(i, sync=state.params)

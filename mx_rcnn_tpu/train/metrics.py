@@ -41,8 +41,13 @@ class MetricAccumulator:
 
 
 class Speedometer:
-    """samples/sec + metric line every ``frequent`` steps (reference
-    semantics; prints through logging, not stdout)."""
+    """samples/sec + metric line, one per call (reference semantics via
+    logging, not stdout).  The train loop decides the cadence — it calls
+    this exactly at its log points, which with ``steps_per_call``>1 need
+    not be multiples of anything — so speed is computed from the actual
+    step delta between calls.  The first call after construction has no
+    delta (and its window includes XLA compilation), so it logs metrics
+    without a speed figure."""
 
     def __init__(self, batch_size: int, frequent: int = 20) -> None:
         self.batch_size = batch_size
@@ -52,17 +57,16 @@ class Speedometer:
         self._last_step: Optional[int] = None
 
     def __call__(self, step: int, metrics: dict) -> None:
-        """Log a line for this call.  The loop invokes this exactly at its
-        log points (which with steps_per_call>1 need not be multiples of
-        ``frequent``), so speed is computed from the actual step delta
-        since the previous call rather than assuming ``frequent`` steps."""
         self._acc.update(metrics)
-        delta = self.frequent if self._last_step is None else step - self._last_step
-        self._last_step = step
-        elapsed = time.monotonic() - self._tic
-        speed = max(delta, 1) * self.batch_size / max(elapsed, 1e-9)
         parts = ", ".join(f"{k}={v:.4f}" for k, v in self._acc.summary().items())
-        log.info("step %d speed %.2f samples/sec %s", step, speed, parts)
+        if self._last_step is None:
+            log.info("step %d %s", step, parts)
+        else:
+            delta = max(step - self._last_step, 1)
+            elapsed = time.monotonic() - self._tic
+            speed = delta * self.batch_size / max(elapsed, 1e-9)
+            log.info("step %d speed %.2f samples/sec %s", step, speed, parts)
+        self._last_step = step
         self._acc.reset()
         self._tic = time.monotonic()
 

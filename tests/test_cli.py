@@ -80,10 +80,15 @@ class TestDriverRoundTrip:
         ckpt = f"{cfg.workdir}/{cfg.name}/ckpt"
         assert os.path.isdir(ckpt)
 
-        # eval from the checkpoint on disk (test.py parity) + dump.
+        # eval from the checkpoint on disk (test.py parity) + dump + vis
+        # (reference pred_eval(vis=True) parity).
         dump = str(tmp_path / "dets.pkl")
-        metrics = run_eval(cfg, dump_path=dump)
+        metrics = run_eval(cfg, dump_path=dump, vis_count=2)
         assert "mAP" in metrics or any("AP" in k for k in metrics)
+        vis_dir = f"{cfg.workdir}/{cfg.name}/vis"
+        pngs = [f for f in os.listdir(vis_dir) if f.endswith(".png")]
+        assert len(pngs) == 2
+        assert all(os.path.getsize(os.path.join(vis_dir, f)) > 0 for f in pngs)
 
         # reeval parity: same metrics from the dump, no model.
         per_image = load_detections(dump)
