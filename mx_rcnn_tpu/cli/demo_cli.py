@@ -14,7 +14,7 @@ import numpy as np
 
 from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.evalutil.vis import draw_detections  # noqa: F401 (re-export: CLI surface)
+from mx_rcnn_tpu.evalutil.vis import draw_detections
 
 log = logging.getLogger("mx_rcnn_tpu.demo")
 
@@ -76,8 +76,6 @@ def detect_image(cfg: Config, variables, image: np.ndarray,
         mask_threshold=mask_threshold,
     )
     return d["boxes"], d["scores"], d["classes"], d.get("masks")
-
-
 
 
 def main(argv=None):

@@ -86,18 +86,20 @@ def run_eval(
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
     model = TwoStageDetector(cfg=cfg.model)
-    # All visible chips evaluate in data parallel: one image per chip per
-    # step (the reference's test path is strictly single-device).  Gated to
-    # single-process runs: multi-host eval would need per-host roidb shards
-    # + global array assembly (shard_batch) and a cross-host metric merge.
+    # All visible chips evaluate in data parallel, test.per_device_batch
+    # images per chip per step (the reference's test path is strictly
+    # single-device, one image at a time).  Gated to single-process runs:
+    # multi-host eval would need per-host roidb shards + global array
+    # assembly (shard_batch) and a cross-host metric merge.
     mesh = (
         make_mesh()
         if jax.device_count() > 1 and jax.process_count() == 1
         else None
     )
     eval_step = make_eval_step(model, mesh=mesh)
+    per_chip = max(cfg.model.test.per_device_batch, 1)
     roidb, loader = _eval_loader(
-        cfg, batch_size=mesh.size if mesh is not None else 1
+        cfg, batch_size=(mesh.size if mesh is not None else 1) * per_chip
     )
     style = "voc" if cfg.data.dataset == "voc" else "coco"
     class_names = None

@@ -119,6 +119,9 @@ class MaskConfig:
 class TestConfig:
     """Inference-time postprocessing (reference: config.TEST + pred_eval)."""
 
+    # Eval images per chip per call (reference: strictly 1).  >1 amortizes
+    # per-dispatch overhead and fills the MXU better at eval time.
+    per_device_batch: int = 1
     score_threshold: float = 0.05
     nms_threshold: float = 0.5  # per-class NMS (reference uses 0.3 for VOC)
     max_detections: int = 100

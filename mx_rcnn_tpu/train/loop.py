@@ -182,7 +182,7 @@ def train(
     if mesh is not None:
         state = jax.device_put(state, replicated(mesh))
 
-    speedo = Speedometer(global_batch, cfg.train.log_every)
+    speedo = Speedometer(global_batch)
     start = int(state.step)
     writer = None
     if workdir and jax.process_index() == 0:

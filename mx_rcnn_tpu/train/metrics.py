@@ -3,8 +3,9 @@
 Replaces ``rcnn/core/metric.py`` (RPNAcc / RPNLogLoss / RPNL1Loss /
 RCNNAcc / RCNNLogLoss / RCNNL1Loss EvalMetrics — here the same six scalars
 are computed in-graph by ``detection.graph.forward_train`` and merely
-averaged on host) and ``rcnn/core/callback.py::Speedometer`` (samples/sec
-every ``frequent`` batches).
+averaged on host) and ``rcnn/core/callback.py::Speedometer`` (the
+reference logs samples/sec every ``frequent`` batches; here the train
+loop owns the cadence and the Speedometer logs once per call).
 """
 
 from __future__ import annotations
@@ -49,9 +50,8 @@ class Speedometer:
     delta (and its window includes XLA compilation), so it logs metrics
     without a speed figure."""
 
-    def __init__(self, batch_size: int, frequent: int = 20) -> None:
+    def __init__(self, batch_size: int) -> None:
         self.batch_size = batch_size
-        self.frequent = frequent
         self._acc = MetricAccumulator()
         self._tic = time.monotonic()
         self._last_step: Optional[int] = None
