@@ -142,3 +142,21 @@ class TestDriverRoundTrip:
         assert os.path.exists(os.path.join(str(tmp_path), cfg.name, "proposals_rpn1.pkl"))
         leaves = jax.tree_util.tree_leaves(state.params)
         assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+
+@pytest.mark.slow
+class TestEvalBatching:
+    def test_metrics_invariant_to_eval_batch(self, tmp_path):
+        """test.per_device_batch must not change eval results (the loader
+        pads tails with repeats but yields only real records)."""
+        from mx_rcnn_tpu.cli.eval_cli import run_eval
+        from mx_rcnn_tpu.train.loop import train
+
+        cfg = _tiny(tmp_path, steps=2)
+        state = train(cfg, mesh=None, workdir=cfg.workdir)
+        m1 = run_eval(cfg, state=state)
+        cfg3 = apply_overrides(cfg, ["model.test.per_device_batch=3"])
+        m3 = run_eval(cfg3, state=state)
+        assert set(m1) == set(m3)
+        for k in m1:
+            np.testing.assert_allclose(m1[k], m3[k], atol=1e-6, err_msg=k)
