@@ -34,7 +34,10 @@ def main() -> None:
     # canvas so the bench finishes (and is labeled by vs_baseline anyway).
     on_accel = platform in ("tpu", "gpu")
     image_size = (1024, 1024) if on_accel else (256, 256)
-    batch = 1
+    # 2 images per chip: the Detectron-recipe per-device batch (the
+    # BASELINE north-star mAP presumes that recipe); measured +8% img/s
+    # over batch 1 on a v5e.  lr scales linearly via build_all.
+    batch = 2 if on_accel else 1
 
     # steps_per_call: the host-side loop is a lax.scan on device — one
     # dispatch per K steps.  Through the axon tunnel a single dispatch
@@ -45,7 +48,9 @@ def main() -> None:
     cfg = dataclasses.replace(
         cfg,
         data=dataclasses.replace(cfg.data, image_size=image_size, max_gt_boxes=32),
-        train=dataclasses.replace(cfg.train, steps_per_call=k),
+        train=dataclasses.replace(
+            cfg.train, steps_per_call=k, per_device_batch=batch
+        ),
     )
     model, tx, state, step_fn, _ = build_all(cfg, mesh=None)
 
@@ -127,7 +132,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"train_images_per_sec_per_chip[r50_fpn@{h}x{w},{platform}]",
+                "metric": f"train_images_per_sec_per_chip[r50_fpn@{h}x{w},b{batch},{platform}]",
                 "value": round(img_s, 3),
                 "unit": "img/s/chip",
                 "vs_baseline": round(img_s / BASELINE_IMG_S_CHIP, 4),
