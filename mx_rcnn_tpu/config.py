@@ -280,7 +280,7 @@ _register(
         name="r50_coco",
         model=_c4_model(81, "resnet50"),
         data=DataConfig(dataset="coco"),
-        train=TrainConfig(),
+        train=TrainConfig(per_device_batch=2),
     ),
 )
 _register(
@@ -289,7 +289,7 @@ _register(
         name="r101_coco",
         model=_c4_model(81, "resnet101"),
         data=DataConfig(dataset="coco"),
-        train=TrainConfig(),
+        train=TrainConfig(per_device_batch=2),
     ),
 )
 _register(
@@ -298,7 +298,7 @@ _register(
         name="r101_fpn_coco",
         model=_fpn_model(81, "resnet101"),
         data=DataConfig(dataset="coco"),
-        train=TrainConfig(),
+        train=TrainConfig(per_device_batch=2),
     ),
 )
 _register(
@@ -307,7 +307,7 @@ _register(
         name="mask_r50_fpn_coco",
         model=_fpn_model(81, "resnet50", mask=True),
         data=DataConfig(dataset="coco"),
-        train=TrainConfig(),
+        train=TrainConfig(per_device_batch=2),
     ),
 )
 # Default/flagship and test presets.
@@ -317,7 +317,7 @@ _register(
         name="r50_fpn_coco",
         model=_fpn_model(81, "resnet50"),
         data=DataConfig(dataset="coco"),
-        train=TrainConfig(),
+        train=TrainConfig(per_device_batch=2),
     ),
 )
 _register(

@@ -112,6 +112,50 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     return model, tx, state, step_fn, global_batch
 
 
+def _flat_config(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for key, v in d.items():
+        path = f"{prefix}{key}"
+        if isinstance(v, dict):
+            out.update(_flat_config(v, path + "."))
+        else:
+            out[path] = v
+    return out
+
+
+def _warn_config_drift(cfg: Config, config_json_path: str) -> None:
+    """Resuming under a different config than the run was started with
+    silently changes the training trajectory — the global batch / lr scale
+    shift the schedule, and the loader's fast-forward replays a different
+    data order.  The run directory's config.json records the original; log
+    every differing field loudly instead of failing (intentional overrides
+    on resume are legitimate)."""
+    import dataclasses as _dc
+    import json as _json
+    import os as _os
+
+    if not _os.path.exists(config_json_path):
+        return
+    try:
+        with open(config_json_path) as f:
+            saved = _flat_config(_json.load(f))
+    except (OSError, ValueError):  # unreadable/corrupt — nothing to compare
+        return
+    current = _flat_config(_dc.asdict(cfg))
+
+    def norm(v):
+        return list(v) if isinstance(v, tuple) else v
+
+    for key in sorted(set(saved) | set(current)):
+        a, b = saved.get(key), norm(current.get(key))
+        if a != b:
+            log.warning(
+                "resume config drift: %s was %r at run start, now %r — "
+                "schedule/data continuity is NOT guaranteed across this "
+                "change", key, a, b,
+            )
+
+
 def _stacked_batches(it, k: int):
     """Group k consecutive host batches into one (k, B, ...) stacked Batch
     for a steps_per_call>1 device loop."""
@@ -166,6 +210,7 @@ def train(
     if resume and latest_step(ckpt_dir) is not None:
         state = restore_checkpoint(ckpt_dir, state)
         log.info("resumed from %s at step %d", ckpt_dir, int(state.step))
+        _warn_config_drift(cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json")
 
     if loader is None:
         roidb = filter_roidb(build_dataset(cfg.data, train=True).roidb())

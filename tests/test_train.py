@@ -239,3 +239,30 @@ class TestStepsPerCall:
                 np.asarray(a), np.asarray(fb[path]), atol=1e-5,
                 err_msg=jax.tree_util.keystr(path),
             )
+
+
+class TestConfigDriftWarning:
+    def test_warns_on_changed_field(self, tmp_path, caplog):
+        import dataclasses as dc
+        import json
+        import logging
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.train.loop import _warn_config_drift
+
+        cfg = get_config("tiny_synthetic")
+        path = str(tmp_path / "config.json")
+        with open(path, "w") as f:
+            json.dump(dc.asdict(cfg), f)
+
+        changed = dc.replace(
+            cfg, train=dc.replace(cfg.train, per_device_batch=2)
+        )
+        with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+            _warn_config_drift(changed, path)
+        assert any("per_device_batch" in r.message for r in caplog.records)
+
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+            _warn_config_drift(cfg, path)  # unchanged: silent
+        assert not caplog.records
