@@ -137,15 +137,20 @@ class CocoDataset:
         self._images = {im["id"]: im for im in d["images"]}
         self._anns: dict[int, list] = {}
         for a in d["annotations"]:
-            if a.get("iscrowd", 0):
-                continue
             self._anns.setdefault(a["image_id"], []).append(a)
 
     def roidb(self) -> list[RoiRecord]:
         out = []
         for img_id, im in self._images.items():
-            anns = self._anns.get(img_id, [])
-            boxes, classes, masks = [], [], []
+            # Crowd annotations are KEPT and flagged (the reference drops
+            # them — ``rcnn/dataset/coco.py`` skips iscrowd — silently
+            # training anchors inside crowds as negatives and scoring
+            # crowd-overlapping detections as false positives).  Non-crowd
+            # first so gt-slot truncation sheds crowds before real objects.
+            anns = sorted(
+                self._anns.get(img_id, []), key=lambda a: bool(a.get("iscrowd", 0))
+            )
+            boxes, classes, masks, crowd = [], [], [], []
             for a in anns:
                 x, y, bw, bh = a["bbox"]
                 x2, y2 = x + max(bw - 1, 0), y + max(bh - 1, 0)
@@ -154,6 +159,7 @@ class CocoDataset:
                 boxes.append([x, y, x2, y2])
                 classes.append(self.cat_to_label[a["category_id"]])
                 masks.append(a.get("segmentation"))
+                crowd.append(bool(a.get("iscrowd", 0)))
             out.append(
                 RoiRecord(
                     image_id=str(img_id),
@@ -165,6 +171,7 @@ class CocoDataset:
                     boxes=np.asarray(boxes, np.float32).reshape(-1, 4),
                     gt_classes=np.asarray(classes, np.int32),
                     masks=masks or None,
+                    ignore=np.asarray(crowd, bool),
                 )
             )
         return out
@@ -200,13 +207,23 @@ class VocDataset:
         size = tree.find("size")
         h = int(size.find("height").text)
         w = int(size.find("width").text)
-        boxes, classes = [], []
+        # Difficult objects are KEPT and flagged (unless use_diff promotes
+        # them to normal gt): training excludes them from negatives, and
+        # ``voc_eval``'s difficult-ignore matching needs them present in the
+        # gt — the reference keeps them for eval via the raw XML
+        # (``rcnn/dataset/pascal_voc_eval.py::voc_eval``) while its roidb
+        # drops them; one flagged roidb serves both here.  Non-difficult
+        # first so gt-slot truncation sheds them before real objects.
+        objs = []
         for obj in tree.findall("object"):
-            if not self.use_diff and int(obj.find("difficult").text or 0):
-                continue
             name = obj.find("name").text.lower().strip()
             if name not in self._cls_index:
                 continue
+            difficult = bool(int(obj.find("difficult").text or 0))
+            objs.append((difficult and not self.use_diff, name, obj))
+        objs.sort(key=lambda t: t[0])
+        boxes, classes, ignore = [], [], []
+        for ign, name, obj in objs:
             bb = obj.find("bndbox")
             # VOC is 1-based pixel coords.
             boxes.append(
@@ -218,6 +235,7 @@ class VocDataset:
                 ]
             )
             classes.append(self._cls_index[name])
+            ignore.append(ign)
         return RoiRecord(
             image_id=idx,
             image_path=os.path.join(self.devkit, "JPEGImages", f"{idx}.jpg"),
@@ -225,6 +243,7 @@ class VocDataset:
             width=w,
             boxes=np.asarray(boxes, np.float32).reshape(-1, 4),
             gt_classes=np.asarray(classes, np.int32),
+            ignore=np.asarray(ignore, bool),
         )
 
     def roidb(self) -> list[RoiRecord]:

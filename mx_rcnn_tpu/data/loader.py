@@ -126,6 +126,11 @@ class DetectionLoader:
         self.num_workers = num_workers if train else 0
         if not self.roidb:
             raise ValueError("empty roidb shard")
+        # Datasets without any ignore regions ship gt_ignore=None so the
+        # train graph keeps the cheaper no-IoA form (the flag decides the
+        # jitted program's pytree structure, so it must be per-run, not
+        # per-batch).
+        self.with_ignore = any(r.ignore_flags.any() for r in self.roidb)
 
     # -- ordering ----------------------------------------------------------
 
@@ -182,30 +187,41 @@ class DetectionLoader:
             img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
         g = self.cfg.max_gt_boxes
         n = min(len(boxes), g)
+        ign = rec.ignore_flags
         gt_boxes = np.zeros((g, 4), np.float32)
         gt_classes = np.zeros((g,), np.int32)
         gt_valid = np.zeros((g,), bool)
+        gt_ignore = np.zeros((g,), bool)
         gt_boxes[:n] = boxes[:n]
         gt_classes[:n] = rec.gt_classes[:n]
-        gt_valid[:n] = True
+        # A slot is either a real gt (valid), an ignore region (crowd/
+        # difficult — never fg, shields bg sampling), or padding (neither).
+        gt_valid[:n] = ~ign[:n]
+        gt_ignore[:n] = ign[:n]
         masks = None
         if self.with_masks:
             masks = np.zeros((g, GT_MASK_SIZE, GT_MASK_SIZE), np.float32)
             if rec.masks is not None:
                 for i in range(n):
+                    if ign[i]:
+                        # Ignore slots can never be fg mask targets (IoU is
+                        # masked by gt_valid); crowd RLEs are also the most
+                        # expensive to rasterize.
+                        continue
                     m = _rasterize_mask(rec.masks[i], rec.boxes[i])
                     masks[i] = m[:, ::-1] if flip else m
-        return img, (th, tw), gt_boxes, gt_classes, gt_valid, masks, scale
+        return img, (th, tw), gt_boxes, gt_classes, gt_valid, gt_ignore, masks, scale
 
     def _assemble(self, recs: list[RoiRecord], flips: list[bool]) -> Batch:
-        ims, hws, bs, cs, vs, ms = [], [], [], [], [], []
+        ims, hws, bs, cs, vs, igs, ms = [], [], [], [], [], [], []
         for rec, fl in zip(recs, flips):
-            img, (th, tw), gb, gc, gv, gm, _ = self._example(rec, fl)
+            img, (th, tw), gb, gc, gv, gi, gm, _ = self._example(rec, fl)
             ims.append(img)
             hws.append([th, tw])
             bs.append(gb)
             cs.append(gc)
             vs.append(gv)
+            igs.append(gi)
             if gm is not None:
                 ms.append(gm)
         return Batch(
@@ -215,6 +231,7 @@ class DetectionLoader:
             gt_classes=np.stack(cs),
             gt_valid=np.stack(vs),
             gt_masks=np.stack(ms) if ms else None,
+            gt_ignore=np.stack(igs) if self.with_ignore else None,
         )
 
     # -- iteration ---------------------------------------------------------

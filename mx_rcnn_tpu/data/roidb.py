@@ -31,16 +31,30 @@ class RoiRecord:
     masks: Optional[list] = None
     # In-memory image for synthetic data.
     image_array: Optional[np.ndarray] = field(default=None, repr=False)
+    # (n,) bool: COCO crowd / VOC difficult regions.  Kept in the roidb
+    # (the reference drops them — ``rcnn/dataset/coco.py`` skips iscrowd,
+    # ``rcnn/dataset/pascal_voc.py`` drops difficult) so training can
+    # exclude them from negatives and eval can ignore-match them.  None
+    # means all-False.  Datasets order non-ignore boxes first so gt-slot
+    # truncation sheds ignore regions before real objects.
+    ignore: Optional[np.ndarray] = None
 
     @property
     def aspect(self) -> float:
         return self.width / max(self.height, 1)
 
+    @property
+    def ignore_flags(self) -> np.ndarray:
+        """(n,) bool ignore mask, materialized (None → all False)."""
+        if self.ignore is None:
+            return np.zeros(len(self.boxes), bool)
+        return np.asarray(self.ignore, bool)
+
 
 def filter_roidb(roidb: list[RoiRecord]) -> list[RoiRecord]:
-    """Drop images without valid gt boxes (reference:
+    """Drop images without valid (non-ignore) gt boxes (reference:
     ``rcnn/utils/load_data.py::filter_roidb``)."""
-    kept = [r for r in roidb if len(r.boxes) > 0]
+    kept = [r for r in roidb if int((~r.ignore_flags).sum()) > 0]
     return kept
 
 
@@ -67,6 +81,7 @@ def with_flipped(roidb: list[RoiRecord]) -> list[RoiRecord]:
             flipped=True,
             masks=r.masks,
             image_array=r.image_array,
+            ignore=r.ignore,
         )
         for r in roidb
     ]

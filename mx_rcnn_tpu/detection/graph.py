@@ -51,6 +51,9 @@ class Batch(NamedTuple):
     gt_classes: jnp.ndarray   # (B, G) int32, 0 = background/padding
     gt_valid: jnp.ndarray     # (B, G) bool
     gt_masks: Optional[jnp.ndarray] = None  # (B, G, Hm, Wm) float32 in [0,1]
+    # COCO crowd / VOC difficult regions: never fg, and anchors/rois covering
+    # them are excluded from bg sampling.  Disjoint from gt_valid slots.
+    gt_ignore: Optional[jnp.ndarray] = None  # (B, G) bool
 
 
 class Detections(NamedTuple):
@@ -364,11 +367,23 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     b = batch.images.shape[0]
     rng_assign, rng_sample = jax.random.split(rng)
 
+    # gt_ignore=None keeps the cheaper no-IoA graph (in_axes=None maps the
+    # leafless None through vmap untouched; the callees skip the overlap
+    # computation entirely).
+    gt_ignore = batch.gt_ignore
+    gi_axis = 0 if gt_ignore is not None else None
     targets = jax.vmap(
-        lambda k, gt, gv, hw: assign_anchors_cfg(
-            cfg, k, anchors_cat, gt, gv, hw[0], hw[1]
-        )
-    )(jax.random.split(rng_assign, b), batch.gt_boxes, batch.gt_valid, batch.image_hw)
+        lambda k, gt, gv, gi, hw: assign_anchors_cfg(
+            cfg, k, anchors_cat, gt, gv, hw[0], hw[1], gt_ignore=gi
+        ),
+        in_axes=(0, 0, 0, gi_axis, 0),
+    )(
+        jax.random.split(rng_assign, b),
+        batch.gt_boxes,
+        batch.gt_valid,
+        gt_ignore,
+        batch.image_hw,
+    )
 
     rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
 
@@ -383,7 +398,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
 
     samples = jax.vmap(
-        lambda k, rois, rv, gt, gc, gv: sample_rois(
+        lambda k, rois, rv, gt, gc, gv, gi: sample_rois(
             k, rois, rv, gt, gc, gv,
             batch_size=cfg.rcnn.roi_batch_size,
             fg_fraction=cfg.rcnn.fg_fraction,
@@ -391,7 +406,9 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
             bg_iou_hi=cfg.rcnn.bg_iou_hi,
             bg_iou_lo=cfg.rcnn.bg_iou_lo,
             bbox_weights=cfg.rcnn.bbox_weights,
-        )
+            gt_ignore=gi,
+        ),
+        in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
     )(
         jax.random.split(rng_sample, b),
         props.rois,
@@ -399,6 +416,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         batch.gt_boxes,
         batch.gt_classes.astype(jnp.int32),
         batch.gt_valid,
+        gt_ignore,
     )
 
     pooled = _pool_rois(cfg, feats, samples.rois, cfg.rcnn.pooled_size, model.roi_levels)
@@ -452,7 +470,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     return total, metrics
 
 
-def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w):
+def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w, gt_ignore=None):
     return assign_anchors(
         key, anchors, gt, gv, h, w,
         batch_size=cfg.rpn.batch_size,
@@ -460,6 +478,7 @@ def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w):
         positive_iou=cfg.rpn.positive_iou,
         negative_iou=cfg.rpn.negative_iou,
         allowed_border=cfg.rpn.allowed_border,
+        gt_ignore=gt_ignore,
     )
 
 

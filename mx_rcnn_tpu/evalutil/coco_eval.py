@@ -7,9 +7,10 @@ reference reaches it through vendored pycocotools
 matching, 101-point interpolated AP, and the standard 12-number summary
 (AP, AP50, AP75, APs/m/l, AR1/10/100, ARs/m/l).
 
-Differences kept deliberately: crowd annotations are dropped at roidb build
-time (the reference's loader also skips them for training; for strict
-leaderboard parity crowd-ignore matching would be added here).
+Crowd-ignore matching follows pycocotools: crowd gts never count toward
+recall, detections overlapping them (intersection-over-det-area, the
+``iou(..., iscrowd=1)`` measure) match as *ignored* — neither TP nor FP —
+and an already-matched crowd gt can absorb further detections.
 """
 
 from __future__ import annotations
@@ -69,10 +70,14 @@ class CocoEvaluator:
         gt_classes: np.ndarray,   # (m,)
         det_masks: list | None = None,  # n RLE dicts (segm mode)
         gt_masks: list | None = None,   # m RLE dicts (segm mode)
+        gt_crowd: np.ndarray | None = None,  # (m,) bool iscrowd flags
     ) -> None:
         self._images.add(image_id)
         det_boxes = np.asarray(det_boxes, float).reshape(-1, 4)
         gt_boxes = np.asarray(gt_boxes, float).reshape(-1, 4)
+        if gt_crowd is None:
+            gt_crowd = np.zeros(len(gt_boxes), bool)
+        gt_crowd = np.asarray(gt_crowd, bool).reshape(len(gt_boxes))
         if self.iou_type == "segm" and (det_masks is None or gt_masks is None):
             raise ValueError("segm evaluation needs det_masks and gt_masks RLEs")
         for c in range(1, self.num_classes):
@@ -88,6 +93,7 @@ class CocoEvaluator:
                 self._gts[(c, image_id)] = (
                     gt_boxes[gm],
                     [gt_masks[i] for i in gm] if gt_masks is not None else None,
+                    gt_crowd[gm],
                 )
 
     # -- matching ----------------------------------------------------------
@@ -106,19 +112,30 @@ class CocoEvaluator:
             order = np.argsort(-dscores, kind="mergesort")[:max_det]
             dboxes, dscores = dboxes[order], dscores[order]
             dmasks = [dmasks[i] for i in order] if dmasks is not None else []
-        gboxes, gmasks = gt if gt is not None else (np.zeros((0, 4)), [])
+        gboxes, gmasks, g_crowd = (
+            gt if gt is not None else (np.zeros((0, 4)), [], np.zeros(0, bool))
+        )
 
         if self.iou_type == "segm":
             from mx_rcnn_tpu.evalutil.masks import rle_area
 
             garea = np.asarray([rle_area(m) for m in (gmasks or [])], float)
             garea = garea.reshape(len(gboxes))
+            darea = np.asarray([rle_area(m) for m in dmasks], float).reshape(
+                len(dboxes)
+            )
         else:
             garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
-        g_ignore = (garea < area_rng[0]) | (garea > area_rng[1])
+            darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
+        # Crowd gts are ignored regardless of area; area filtering ignores
+        # the rest outside the range (pycocotools _ignore).
+        g_ignore = g_crowd | (garea < area_rng[0]) | (garea > area_rng[1])
         # Sort gt: non-ignored first (COCO matches real gt preferentially).
         g_order = np.argsort(g_ignore, kind="mergesort")
-        gboxes, g_ignore = gboxes[g_order], g_ignore[g_order]
+        gboxes, g_ignore, g_crowd = (
+            gboxes[g_order], g_ignore[g_order], g_crowd[g_order]
+        )
+        garea = garea[g_order]
 
         if self.iou_type == "segm":
             from mx_rcnn_tpu.evalutil.masks import rle_iou
@@ -127,6 +144,13 @@ class CocoEvaluator:
             ious = rle_iou(dmasks, gmasks)
         else:
             ious = _xyxy_iou(dboxes, gboxes)
+        if g_crowd.any() and len(dboxes):
+            # Crowd overlap is intersection-over-det-area (pycocotools
+            # iou(..., iscrowd=1)): recover the intersection from the IoU
+            # and the two areas, renormalize by det area alone.
+            inter = ious * (darea[:, None] + garea[None, :]) / (1.0 + ious)
+            ioa = inter / np.maximum(darea[:, None], 1e-10)
+            ious = np.where(g_crowd[None, :], ioa, ious)
         T, D, G = len(IOU_THRS), len(dboxes), len(gboxes)
         dt_match = np.zeros((T, D), dtype=np.int64)  # 1 + matched gt idx, 0 = none
         gt_match = np.zeros((T, G), dtype=np.int64)
@@ -134,7 +158,9 @@ class CocoEvaluator:
             for di in range(D):
                 best, best_j = min(t, 1 - 1e-10), -1
                 for gi in range(G):
-                    if gt_match[ti, gi] and not g_ignore[gi]:
+                    # A matched real gt is consumed; a crowd gt can absorb
+                    # any number of detections (pycocotools iscrowd rule).
+                    if gt_match[ti, gi] and not g_crowd[gi]:
                         continue
                     # Past non-ignored best, stop upgrading to ignored gt.
                     if best_j > -1 and not g_ignore[best_j] and g_ignore[gi]:
@@ -145,14 +171,6 @@ class CocoEvaluator:
                 if best_j > -1:
                     dt_match[ti, di] = best_j + 1
                     gt_match[ti, best_j] = di + 1
-        if self.iou_type == "segm":
-            from mx_rcnn_tpu.evalutil.masks import rle_area
-
-            darea = np.asarray([rle_area(m) for m in dmasks], float).reshape(
-                len(dboxes)
-            )
-        else:
-            darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
         # Unmatched dets outside the area range are ignored, matched-to-
         # ignored-gt dets are ignored.
         dt_ignore = np.zeros((T, D), bool)

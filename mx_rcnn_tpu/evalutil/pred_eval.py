@@ -70,6 +70,7 @@ def evaluate_detections(
             ev.add_image(
                 rec.image_id, d["boxes"], d["scores"], d["classes"],
                 rec.boxes, rec.gt_classes,
+                gt_crowd=rec.ignore_flags,
             )
             if seg_ev is not None:
                 # An image entry without masks (e.g. merged dumps) contributes
@@ -84,6 +85,7 @@ def evaluate_detections(
                     rec.boxes, rec.gt_classes,
                     det_masks=d.get("masks", []),
                     gt_masks=gt_record_rles(rec),
+                    gt_crowd=rec.ignore_flags,
                 )
         metrics = ev.summarize()
         if seg_ev is not None:
@@ -105,7 +107,13 @@ def evaluate_detections(
                         )
                 gm = rec.gt_classes == c
                 if gm.any():
-                    all_gt[c][rec.image_id] = {"boxes": rec.boxes[gm]}
+                    # Difficult objects stay in the gt with their flag so
+                    # voc_eval's ignore-matching fires (reference voc_eval
+                    # semantics: matched-to-difficult is neither tp nor fp).
+                    all_gt[c][rec.image_id] = {
+                        "boxes": rec.boxes[gm],
+                        "difficult": rec.ignore_flags[gm],
+                    }
         names = class_names or tuple(str(i) for i in range(num_classes))
         return voc_mean_ap(all_dets, all_gt, names, use_07_metric=use_07_metric)
     raise ValueError(f"unknown eval style {style!r}")

@@ -3,6 +3,7 @@ from mx_rcnn_tpu.geometry.boxes import (
     clip_boxes,
     decode_boxes,
     encode_boxes,
+    ioa_matrix,
     iou_matrix,
     valid_box_mask,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "clip_boxes",
     "decode_boxes",
     "encode_boxes",
+    "ioa_matrix",
     "iou_matrix",
     "valid_box_mask",
     "generate_base_anchors",

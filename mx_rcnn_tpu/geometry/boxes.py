@@ -64,6 +64,33 @@ def iou_matrix(
     return jnp.where(union > 0.0, inter / jnp.where(union > 0.0, union, 1.0), 0.0)
 
 
+def ioa_matrix(
+    boxes: jnp.ndarray,
+    query: jnp.ndarray,
+    legacy_plus_one: bool = False,
+) -> jnp.ndarray:
+    """Pairwise intersection-over-area of ``boxes`` (first argument).
+
+    The crowd/ignore overlap measure: a small anchor fully inside a huge
+    crowd region has tiny IoU but IoA 1.0.  Used to exclude anchors/rois
+    overlapping ignore regions from negative sampling and, det-normalized,
+    for COCO crowd-ignore matching (pycocotools ``iou(..., iscrowd=1)``).
+
+    Args:
+      boxes: (N, 4) — the area in the denominator.
+      query: (K, 4).
+    Returns:
+      (N, K); zero-area ``boxes`` rows are 0.
+    """
+    off = 1.0 if legacy_plus_one else 0.0
+    lt = jnp.maximum(boxes[:, None, :2], query[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], query[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    a = area(boxes, legacy_plus_one)[:, None]
+    return jnp.where(a > 0.0, inter / jnp.where(a > 0.0, a, 1.0), 0.0)
+
+
 def _center(boxes: jnp.ndarray, legacy_plus_one: bool = False):
     """(w, h, cx, cy) of boxes under the chosen width convention."""
     off = 1.0 if legacy_plus_one else 0.0

@@ -22,7 +22,27 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from mx_rcnn_tpu.geometry import encode_boxes, iou_matrix
+from mx_rcnn_tpu.geometry import encode_boxes, ioa_matrix, iou_matrix
+
+
+def _ignore_overlap_mask(
+    boxes: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_ignore: jnp.ndarray | None,
+    threshold: float,
+) -> jnp.ndarray:
+    """(N,) bool: box has IoA >= threshold with some ignore/crowd region.
+
+    Reference parity: the upstream loader drops crowd annotations entirely,
+    silently letting anchors inside crowds train as negatives
+    (``rcnn/dataset/coco.py`` skips iscrowd); Detectron-lineage crowd
+    filtering (intersection-over-box-area, not IoU — a small anchor inside
+    a huge crowd has tiny IoU) is the behavior real COCO training needs.
+    """
+    if gt_ignore is None:
+        return jnp.zeros(boxes.shape[0], bool)
+    ioa = ioa_matrix(boxes, gt_boxes) * gt_ignore[None, :].astype(boxes.dtype)
+    return jnp.max(ioa, axis=1) >= threshold
 
 
 def _random_rank(key: jax.Array, candidate: jnp.ndarray) -> jnp.ndarray:
@@ -81,6 +101,8 @@ def assign_anchors(
     positive_iou: float = 0.7,
     negative_iou: float = 0.3,
     allowed_border: float = 0.0,
+    gt_ignore: jnp.ndarray | None = None,
+    ignore_ioa: float = 0.5,
 ) -> AnchorTargets:
     """Label anchors for RPN training (reference assign_anchor semantics).
 
@@ -92,7 +114,10 @@ def assign_anchors(
     - subsample to ``batch_size`` with at most ``fg_fraction`` positives;
       leftover fg quota is given to bg (reference behavior).
 
-    ``gt_boxes`` is padded to a static G with ``gt_valid`` masking.
+    ``gt_boxes`` is padded to a static G with ``gt_valid`` masking; slots
+    flagged in ``gt_ignore`` (COCO crowd / VOC difficult) are never fg
+    matches, and anchors covering them (IoA >= ``ignore_ioa``) are excluded
+    from bg so crowds don't train as negatives.
     """
     a = anchors.shape[0]
     inside = (
@@ -120,7 +145,8 @@ def assign_anchors(
     )
 
     fg_cand = inside & any_gt & ((max_iou >= positive_iou) | is_gt_best)
-    bg_cand = inside & (max_iou < negative_iou) & ~fg_cand
+    in_ignore = _ignore_overlap_mask(anchors, gt_boxes, gt_ignore, ignore_ioa)
+    bg_cand = inside & (max_iou < negative_iou) & ~fg_cand & ~in_ignore
 
     num_fg_quota = int(batch_size * fg_fraction)
     k_fg, k_bg = jax.random.split(key)
@@ -169,6 +195,8 @@ def sample_rois(
     bg_iou_hi: float = 0.5,
     bg_iou_lo: float = 0.0,
     bbox_weights: tuple[float, float, float, float] = (10.0, 10.0, 5.0, 5.0),
+    gt_ignore: jnp.ndarray | None = None,
+    ignore_ioa: float = 0.5,
 ) -> RoiSamples:
     """Sample proposals into a fixed R-CNN minibatch with targets.
 
@@ -190,7 +218,14 @@ def sample_rois(
     argmax_gt = jnp.argmax(iou, axis=1)
 
     fg_cand = all_valid & (max_iou >= fg_iou)
-    bg_cand = all_valid & (max_iou < bg_iou_hi) & (max_iou >= bg_iou_lo) & ~fg_cand
+    in_ignore = _ignore_overlap_mask(all_rois, gt_boxes, gt_ignore, ignore_ioa)
+    bg_cand = (
+        all_valid
+        & (max_iou < bg_iou_hi)
+        & (max_iou >= bg_iou_lo)
+        & ~fg_cand
+        & ~in_ignore
+    )
 
     num_fg_quota = int(batch_size * fg_fraction)
     k_fg, k_bg = jax.random.split(key)

@@ -158,9 +158,20 @@ class TestVoc:
         assert len(roidb) == 1
         r = roidb[0]
         assert (r.height, r.width) == (100, 200)
-        # difficult object skipped; VOC 1-based → 0-based
-        np.testing.assert_allclose(r.boxes, [[10, 20, 60, 80]])
+        # Difficult object kept but flagged, ordered after real gt;
+        # VOC 1-based → 0-based.
+        np.testing.assert_allclose(r.boxes, [[10, 20, 60, 80], [0, 0, 8, 8]])
         assert ds.classes[r.gt_classes[0]] == "dog"
+        assert ds.classes[r.gt_classes[1]] == "person"
+        np.testing.assert_array_equal(r.ignore_flags, [False, True])
+
+    def test_use_diff_promotes_difficult(self, tmp_path):
+        ds = VocDataset(
+            str(self._make_devkit(tmp_path)), "2007_trainval", use_diff=True
+        )
+        r = ds.roidb()[0]
+        assert len(r.boxes) == 2
+        np.testing.assert_array_equal(r.ignore_flags, [False, False])
 
 
 class TestCoco:
@@ -186,8 +197,10 @@ class TestCoco:
         roidb = ds.roidb()
         assert len(roidb) == 1
         r = roidb[0]
-        assert len(r.boxes) == 1  # crowd skipped
-        np.testing.assert_allclose(r.boxes, [[10, 10, 29, 29]])
+        # Crowd kept but flagged, ordered after real gt.
+        assert len(r.boxes) == 2
+        np.testing.assert_allclose(r.boxes, [[10, 10, 29, 29], [5, 5, 14, 14]])
+        np.testing.assert_array_equal(r.ignore_flags, [False, True])
         # Sparse id 9 → contiguous label 2 ("boat" after sorted ids [3, 9]).
         assert r.gt_classes[0] == 2
         assert ds.label_to_cat[2] == 9

@@ -155,3 +155,89 @@ class TestDetectionCache:
             loaded, roidb, num_classes=2, style="voc", class_names=("bg", "obj")
         )
         assert res_voc["mAP"] == pytest.approx(1.0)
+
+
+class TestCrowdIgnore:
+    """COCO crowd-ignore matching (pycocotools iscrowd semantics)."""
+
+    def _run(self, dets, scores, gt, crowd):
+        ev = CocoEvaluator(num_classes=2)
+        ev.add_image(
+            "a", dets, scores, np.ones(len(dets), int),
+            gt, np.ones(len(gt), int), gt_crowd=crowd,
+        )
+        return ev.summarize()
+
+    def test_crowd_det_is_neither_tp_nor_fp(self):
+        # A higher-scored detection on the crowd must not cap precision:
+        # with crowd handling AP stays 1.0; as a plain FP it would be ~0.5.
+        gt = np.array([[0, 0, 20, 20], [50, 50, 90, 90]], float)
+        dets = np.array([[52, 52, 88, 88], [0, 0, 20, 20]], float)
+        s = self._run(dets, np.array([0.9, 0.8]), gt, np.array([False, True]))
+        assert s["AP"] == pytest.approx(1.0)
+        assert s["AR100"] == pytest.approx(1.0)  # crowd not in recall pool
+
+    def test_crowd_absorbs_multiple_dets(self):
+        gt = np.array([[0, 0, 20, 20], [50, 50, 90, 90]], float)
+        dets = np.array(
+            [[52, 52, 88, 88], [51, 51, 89, 89], [0, 0, 20, 20]], float
+        )
+        s = self._run(
+            dets, np.array([0.9, 0.85, 0.8]), gt, np.array([False, True])
+        )
+        assert s["AP"] == pytest.approx(1.0)
+
+    def test_crowd_overlap_is_intersection_over_det_area(self):
+        # Tiny det fully inside a huge crowd: IoU ~0.01 but IoA = 1.0 —
+        # must be ignored, not an FP.
+        gt = np.array([[0, 0, 20, 20], [30, 30, 300, 300]], float)
+        dets = np.array([[100, 100, 120, 120], [0, 0, 20, 20]], float)
+        s = self._run(dets, np.array([0.9, 0.8]), gt, np.array([False, True]))
+        assert s["AP"] == pytest.approx(1.0)
+
+    def test_real_gt_preferred_over_crowd(self):
+        # A det overlapping both a real gt (IoU .55) and a crowd must match
+        # the real gt at thresholds it clears (counting as TP, not ignored).
+        gt = np.array([[0, 0, 100, 100], [0, 0, 400, 400]], float)
+        dets = np.array([[0, 0, 100, 55]], float)  # IoU 0.55 with real gt
+        s = self._run(dets, np.array([0.9]), gt, np.array([False, True]))
+        assert s["AP50"] == pytest.approx(1.0)
+
+    def test_evaluate_detections_passes_crowd(self):
+        rec = RoiRecord(
+            image_id="a", image_path="", height=100, width=100,
+            boxes=np.array([[0, 0, 20, 20], [50, 50, 90, 90]], np.float32),
+            gt_classes=np.array([1, 1], np.int32),
+            ignore=np.array([False, True]),
+        )
+        per_image = {
+            "a": {
+                "boxes": np.array([[52, 52, 88, 88], [0, 0, 20, 20]], float),
+                "scores": np.array([0.9, 0.8]),
+                "classes": np.array([1, 1]),
+            }
+        }
+        out = evaluate_detections(per_image, [rec], num_classes=2, style="coco")
+        assert out["AP"] == pytest.approx(1.0)
+
+    def test_evaluate_detections_voc_difficult(self):
+        # Same scenario through the VOC path: det on the difficult gt is
+        # ignored (voc_eval receives the flag from the roidb).
+        rec = RoiRecord(
+            image_id="a", image_path="", height=100, width=100,
+            boxes=np.array([[0, 0, 20, 20], [50, 50, 90, 90]], np.float32),
+            gt_classes=np.array([1, 1], np.int32),
+            ignore=np.array([False, True]),
+        )
+        per_image = {
+            "a": {
+                "boxes": np.array([[50, 50, 90, 90], [0, 0, 20, 20]], float),
+                "scores": np.array([0.9, 0.8]),
+                "classes": np.array([1, 1]),
+            }
+        }
+        out = evaluate_detections(
+            per_image, [rec], num_classes=2, style="voc",
+            class_names=("bg", "thing"),
+        )
+        assert out["mAP"] == pytest.approx(1.0)

@@ -430,3 +430,60 @@ def test_sample_rois_padding_zero_weight(rng):
     w = np.asarray(s.label_weights)
     assert w.sum() <= 4  # 4 fg candidates max (3 rois + 1 gt), no bg
     assert (w[int(w.sum()):] == 0).all()
+
+
+# ---------------- ignore regions (COCO crowd / VOC difficult) ----------------
+
+
+def test_assign_anchors_crowd_never_bg():
+    # One valid gt in a corner plus an ignore (crowd) region: anchors
+    # covering the crowd (IoA >= 0.5) must never be labeled background —
+    # the reference silently trained them as negatives after dropping
+    # crowd annotations at roidb build.
+    base = generate_base_anchors(16, (1.0,), (2,))  # 32px anchors
+    anchors = shifted_anchors(jnp.asarray(base), 16, 6, 6)  # 96px image
+    gt = jnp.asarray([[4.0, 4.0, 35.0, 35.0], [48.0, 48.0, 95.0, 95.0]])
+    gt_valid = jnp.asarray([True, False])
+    gt_ignore = jnp.asarray([False, True])
+    t = assign_anchors(
+        jax.random.key(0), anchors, gt, gt_valid, 96.0, 96.0,
+        batch_size=256, gt_ignore=gt_ignore,
+    )
+    from mx_rcnn_tpu.geometry import ioa_matrix
+
+    ioa = np.asarray(ioa_matrix(anchors, gt[1:2])).ravel()
+    labels = np.asarray(t.labels)
+    covered = ioa >= 0.5
+    assert covered.any()  # the grid does cover the crowd
+    assert (labels[covered] != 0).all()
+    # Without the flag those same anchors DO become bg (the regression
+    # the flag exists to prevent).
+    t0 = assign_anchors(
+        jax.random.key(0), anchors, gt[:1], gt_valid[:1], 96.0, 96.0,
+        batch_size=256,
+    )
+    assert (np.asarray(t0.labels)[covered] == 0).any()
+
+
+def test_sample_rois_crowd_never_bg():
+    gt = jnp.asarray([[10.0, 10.0, 50.0, 60.0], [80.0, 80.0, 126.0, 126.0]])
+    gc = jnp.asarray([3, 0], jnp.int32)
+    gv = jnp.asarray([True, False])
+    gi = jnp.asarray([False, True])
+    rois = jnp.asarray(
+        [[11.0, 11.0, 50.0, 59.0]] * 3          # fg
+        + [[82.0, 82.0, 124.0, 124.0]] * 5      # inside the crowd
+        + [[150.0, 150.0, 200.0, 200.0]] * 5,   # clean bg
+        dtype=jnp.float32,
+    )
+    s = sample_rois(
+        jax.random.key(0), rois, jnp.ones(13, bool), gt, gc, gv,
+        batch_size=32, fg_fraction=0.25, gt_ignore=gi,
+    )
+    from mx_rcnn_tpu.geometry import ioa_matrix
+
+    picked = np.asarray(s.label_weights) > 0
+    bg = picked & ~np.asarray(s.fg_mask)
+    assert bg.any()  # clean bg still sampled
+    ioa = np.asarray(ioa_matrix(s.rois, gt[1:2])).ravel()
+    assert (ioa[bg] < 0.5).all()
