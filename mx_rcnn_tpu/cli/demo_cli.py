@@ -99,7 +99,9 @@ def main(argv=None):
     else:
         from mx_rcnn_tpu.cli.eval_cli import _restored_state
 
-        variables = eval_variables(jax.device_get(_restored_state(cfg, args.ckpt, args.step)))
+        variables = jax.device_put(
+            eval_variables(_restored_state(cfg, args.ckpt, args.step))
+        )
 
     boxes, scores, classes, masks = detect_image(
         cfg, variables, image, mask_threshold=args.threshold

@@ -57,12 +57,22 @@ def _eval_loader(cfg: Config, batch_size: int = 1, with_masks: bool = False):
 
 
 def _restored_state(cfg: Config, ckpt_dir: Optional[str], step: Optional[int]):
+    import jax
+
     from mx_rcnn_tpu.train.checkpoint import restore_checkpoint
     from mx_rcnn_tpu.train.loop import build_all
 
-    _, _, state, _, _ = build_all(cfg, mesh=None)
+    # restore_checkpoint only needs the target's tree structure and
+    # shapes/dtypes, so build it under eval_shape: no parameter is ever
+    # materialized on device just to be thrown away (the eager init cost
+    # minutes of cold-start through the TPU tunnel).
+    def make_state():
+        _, _, state, _, _ = build_all(cfg, mesh=None)
+        return state
+
+    abstract = jax.eval_shape(make_state)
     ckpt = ckpt_dir or f"{cfg.workdir}/{cfg.name}/ckpt"
-    return restore_checkpoint(ckpt, state, step=step)
+    return restore_checkpoint(ckpt, abstract, step=step)
 
 
 def run_eval(
@@ -79,7 +89,7 @@ def run_eval(
 
     from mx_rcnn_tpu.detection import TwoStageDetector
     from mx_rcnn_tpu.evalutil import pred_eval
-    from mx_rcnn_tpu.parallel import make_mesh
+    from mx_rcnn_tpu.parallel import make_mesh, replicated
     from mx_rcnn_tpu.parallel.step import eval_variables, make_eval_step
 
     if state is None:
@@ -97,6 +107,16 @@ def run_eval(
         else None
     )
     eval_step = make_eval_step(model, mesh=mesh)
+    # Pin the inference params on device ONCE.  Feeding the numpy pytree
+    # into the jitted step would re-upload every parameter on every call —
+    # ~100 MB/step through the TPU tunnel, turning an ~90 ms eval step into
+    # ~10 s (measured; the r1 CLI had exactly this bug).
+    variables = eval_variables(state)
+    variables = (
+        jax.device_put(variables, replicated(mesh))
+        if mesh is not None
+        else jax.device_put(variables)
+    )
     per_chip = max(cfg.model.test.per_device_batch, 1)
     roidb, loader = _eval_loader(
         cfg, batch_size=(mesh.size if mesh is not None else 1) * per_chip
@@ -109,7 +129,7 @@ def run_eval(
         class_names = ("__background__",) + VOC_CLASSES
     metrics = pred_eval(
         eval_step,
-        eval_variables(state),
+        variables,
         loader,
         roidb,
         cfg.model.num_classes,
@@ -151,7 +171,8 @@ def dump_proposals(
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
     model = TwoStageDetector(cfg=cfg.model)
-    variables = eval_variables(state)
+    # Device-resident params: see run_eval — numpy params re-upload per call.
+    variables = jax.device_put(eval_variables(state))
     prop_step = jax.jit(lambda v, b: forward_proposals(model, v, b))
 
     data_cfg = cfg.data

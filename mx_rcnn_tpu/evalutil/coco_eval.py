@@ -43,6 +43,98 @@ def _xyxy_iou(d: np.ndarray, g: np.ndarray) -> np.ndarray:
     return inter / np.maximum(ad[:, None] + ag[None, :] - inter, 1e-10)
 
 
+def _greedy_match_reference(
+    ious: np.ndarray, g_ignore: np.ndarray, g_crowd: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """The pycocotools matching rule as a literal triple loop (test oracle).
+
+    gts must be sorted non-ignored-first.  Returns (dt_match (T, D) holding
+    1 + matched gt index or 0, gt_match (T, G) holding 1 + det index).
+    """
+    D, G = ious.shape
+    T = len(IOU_THRS)
+    dt_match = np.zeros((T, D), dtype=np.int64)
+    gt_match = np.zeros((T, G), dtype=np.int64)
+    for ti, t in enumerate(IOU_THRS):
+        for di in range(D):
+            best, best_j = min(t, 1 - 1e-10), -1
+            for gi in range(G):
+                # A matched real gt is consumed; a crowd gt can absorb
+                # any number of detections (pycocotools iscrowd rule).
+                if gt_match[ti, gi] and not g_crowd[gi]:
+                    continue
+                # Past non-ignored best, stop upgrading to ignored gt.
+                if best_j > -1 and not g_ignore[best_j] and g_ignore[gi]:
+                    break
+                if ious[di, gi] < best:
+                    continue
+                best, best_j = ious[di, gi], gi
+            if best_j > -1:
+                dt_match[ti, di] = best_j + 1
+                gt_match[ti, best_j] = di + 1
+    return dt_match, gt_match
+
+
+def _greedy_match_batched(
+    ious: np.ndarray, g_ignore: np.ndarray, g_crowd: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized :func:`_greedy_match_reference` (bit-identical), batched
+    over A independent problems sharing the det list — the evaluator folds
+    the four area buckets (whose gt columns are permutations of one IoU
+    matrix) into one call.
+
+    The det loop is inherently sequential (each det consumes a gt), but per
+    det the A×T×G search collapses to array ops: among available real gts
+    pick the last index attaining the max IoU (the oracle's ``>=`` update
+    makes later ties win); only if none clears the threshold may an
+    available ignored gt match (the oracle's break rule — reaching the
+    ignored block with a real candidate stops the scan).  Dets whose max
+    IoU misses the lowest threshold can never match (the max is invariant
+    to the per-problem column permutation) and are skipped.
+
+    Args: ious (A, D, G); g_ignore, g_crowd (A, G).
+    Returns: (dt_match (A, T, D), gt_match (A, T, G)).
+    """
+    A, D, G = ious.shape
+    T = len(IOU_THRS)
+    dt_match = np.zeros((A, T, D), dtype=np.int64)
+    gt_match = np.zeros((A, T, G), dtype=np.int64)
+    if D == 0 or G == 0:
+        return dt_match, gt_match
+    thr = np.minimum(IOU_THRS, 1 - 1e-10)[None, :]  # (1, T)
+    real = ~g_ignore[:, None, :]                    # (A, 1, G)
+    ign = g_ignore[:, None, :]
+    crowd_avail = (g_ignore & g_crowd)[:, None, :]  # crowd: matched-but-available
+    aidx = np.arange(A)[:, None]
+    tidx = np.arange(T)[None, :]
+    active = np.flatnonzero(ious[0].max(axis=1) >= thr.min())
+    for d in active:
+        iou_d = ious[:, d, None, :]                             # (A, 1, G)
+        free = gt_match == 0                                    # (A, T, G)
+        cand = np.where(real & free, iou_d, -1.0)
+        j_real = G - 1 - np.argmax(cand[:, :, ::-1], axis=2)    # last argmax
+        ok_real = cand[aidx, tidx, j_real] >= thr               # (A, T)
+        cand = np.where(crowd_avail | (ign & free), iou_d, -1.0)
+        j_ign = G - 1 - np.argmax(cand[:, :, ::-1], axis=2)
+        ok_ign = ~ok_real & (cand[aidx, tidx, j_ign] >= thr)
+        j = np.where(ok_real, j_real, np.where(ok_ign, j_ign, -1))
+        hit = j >= 0
+        dt_match[hit, d] = j[hit] + 1
+        a_hit, t_hit = np.nonzero(hit)
+        gt_match[a_hit, t_hit, j[hit]] = d + 1
+    return dt_match, gt_match
+
+
+def _greedy_match(
+    ious: np.ndarray, g_ignore: np.ndarray, g_crowd: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Single-problem wrapper over :func:`_greedy_match_batched`."""
+    dt, gtm = _greedy_match_batched(
+        ious[None], np.asarray(g_ignore, bool)[None], np.asarray(g_crowd, bool)[None]
+    )
+    return dt[0], gtm[0]
+
+
 class CocoEvaluator:
     """Accumulate per-image detections + gt, then summarize.
 
@@ -58,7 +150,9 @@ class CocoEvaluator:
         # (cat, image) → dict(dt=..., gt=..., iou=...)
         self._dts: dict = defaultdict(list)
         self._gts: dict = defaultdict(list)
-        self._images: set = set()
+        # cat → insertion-ordered image ids with dets or gt of that class
+        # (dict as ordered set: deterministic accumulation order).
+        self._cat_images: dict = defaultdict(dict)
 
     def add_image(
         self,
@@ -72,7 +166,6 @@ class CocoEvaluator:
         gt_masks: list | None = None,   # m RLE dicts (segm mode)
         gt_crowd: np.ndarray | None = None,  # (m,) bool iscrowd flags
     ) -> None:
-        self._images.add(image_id)
         det_boxes = np.asarray(det_boxes, float).reshape(-1, 4)
         gt_boxes = np.asarray(gt_boxes, float).reshape(-1, 4)
         if gt_crowd is None:
@@ -95,54 +188,46 @@ class CocoEvaluator:
                     [gt_masks[i] for i in gm] if gt_masks is not None else None,
                     gt_crowd[gm],
                 )
+            if dm.size or gm.size:
+                self._cat_images[c][image_id] = None
 
     # -- matching ----------------------------------------------------------
 
-    def _evaluate_img(self, cat: int, img, area_rng, max_det: int):
-        dt = self._dts.get((cat, img))
-        gt = self._gts.get((cat, img))
-        if dt is None and gt is None:
-            return None
+    def _cached_ious(self, cat: int, img, cache: dict):
+        """(ious, dscores, darea, garea, g_crowd) for a (cat, img) pair:
+        dets score-sorted and capped at MAX_DETS[-1], gts in stored order,
+        crowd columns already converted to intersection-over-det-area.
+        Area-range filtering only permutes/ignores gt columns, so one cache
+        entry serves all four area buckets (pycocotools computes its ious
+        once the same way).
+        """
+        key = (cat, img)
+        if key in cache:
+            return cache[key]
+        dt = self._dts.get(key)
+        gt = self._gts.get(key)
         if dt is None:
-            dboxes = np.zeros((0, 4))
-            dscores = np.zeros(0)
-            dmasks = []
+            dboxes, dscores, dmasks = np.zeros((0, 4)), np.zeros(0), []
         else:
             dboxes, dscores, dmasks = dt
-            order = np.argsort(-dscores, kind="mergesort")[:max_det]
+            order = np.argsort(-dscores, kind="mergesort")[: MAX_DETS[-1]]
             dboxes, dscores = dboxes[order], dscores[order]
             dmasks = [dmasks[i] for i in order] if dmasks is not None else []
         gboxes, gmasks, g_crowd = (
             gt if gt is not None else (np.zeros((0, 4)), [], np.zeros(0, bool))
         )
-
         if self.iou_type == "segm":
-            from mx_rcnn_tpu.evalutil.masks import rle_area
+            from mx_rcnn_tpu.evalutil.masks import rle_area, rle_iou
 
             garea = np.asarray([rle_area(m) for m in (gmasks or [])], float)
             garea = garea.reshape(len(gboxes))
             darea = np.asarray([rle_area(m) for m in dmasks], float).reshape(
                 len(dboxes)
             )
+            ious = rle_iou(dmasks, gmasks or [])
         else:
             garea = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
             darea = (dboxes[:, 2] - dboxes[:, 0]) * (dboxes[:, 3] - dboxes[:, 1])
-        # Crowd gts are ignored regardless of area; area filtering ignores
-        # the rest outside the range (pycocotools _ignore).
-        g_ignore = g_crowd | (garea < area_rng[0]) | (garea > area_rng[1])
-        # Sort gt: non-ignored first (COCO matches real gt preferentially).
-        g_order = np.argsort(g_ignore, kind="mergesort")
-        gboxes, g_ignore, g_crowd = (
-            gboxes[g_order], g_ignore[g_order], g_crowd[g_order]
-        )
-        garea = garea[g_order]
-
-        if self.iou_type == "segm":
-            from mx_rcnn_tpu.evalutil.masks import rle_iou
-
-            gmasks = [gmasks[i] for i in g_order] if gmasks else []
-            ious = rle_iou(dmasks, gmasks)
-        else:
             ious = _xyxy_iou(dboxes, gboxes)
         if g_crowd.any() and len(dboxes):
             # Crowd overlap is intersection-over-det-area (pycocotools
@@ -151,92 +236,105 @@ class CocoEvaluator:
             inter = ious * (darea[:, None] + garea[None, :]) / (1.0 + ious)
             ioa = inter / np.maximum(darea[:, None], 1e-10)
             ious = np.where(g_crowd[None, :], ioa, ious)
-        T, D, G = len(IOU_THRS), len(dboxes), len(gboxes)
-        dt_match = np.zeros((T, D), dtype=np.int64)  # 1 + matched gt idx, 0 = none
-        gt_match = np.zeros((T, G), dtype=np.int64)
-        for ti, t in enumerate(IOU_THRS):
-            for di in range(D):
-                best, best_j = min(t, 1 - 1e-10), -1
-                for gi in range(G):
-                    # A matched real gt is consumed; a crowd gt can absorb
-                    # any number of detections (pycocotools iscrowd rule).
-                    if gt_match[ti, gi] and not g_crowd[gi]:
-                        continue
-                    # Past non-ignored best, stop upgrading to ignored gt.
-                    if best_j > -1 and not g_ignore[best_j] and g_ignore[gi]:
-                        break
-                    if ious[di, gi] < best:
-                        continue
-                    best, best_j = ious[di, gi], gi
-                if best_j > -1:
-                    dt_match[ti, di] = best_j + 1
-                    gt_match[ti, best_j] = di + 1
-        # Unmatched dets outside the area range are ignored, matched-to-
-        # ignored-gt dets are ignored.
-        dt_ignore = np.zeros((T, D), bool)
-        for ti in range(T):
-            for di in range(D):
-                j = dt_match[ti, di] - 1
-                if j >= 0:
-                    dt_ignore[ti, di] = g_ignore[j]
-                else:
-                    dt_ignore[ti, di] = (darea[di] < area_rng[0]) | (
-                        darea[di] > area_rng[1]
-                    )
-        return {
-            "scores": dscores,
-            "dt_match": dt_match,
-            "dt_ignore": dt_ignore,
-            "num_gt": int((~g_ignore).sum()),
-        }
+        entry = (ious, dscores, darea, garea, g_crowd)
+        cache[key] = entry
+        return entry
 
-    def _accumulate(self, cat: int, area: str, max_det: int):
+    def _evaluate_img(self, cat: int, img, cache: dict):
+        """→ {area: per-image match record}, one batched matcher call.
+
+        Matches at maxDet=MAX_DETS[-1]; smaller maxDets are prefix slices
+        of the returned arrays (greedy matching in score order is
+        prefix-consistent — det k's match never depends on det k+1).  The
+        four area buckets share one IoU matrix (area filtering only flips
+        ignore flags and permutes gt columns), so they run as one batched
+        problem."""
+        if (cat, img) not in self._dts and (cat, img) not in self._gts:
+            return None
+        ious, dscores, darea, garea, g_crowd = self._cached_ious(cat, img, cache)
+        areas = list(AREA_RANGES.items())
+        ious_a, ign_a, crowd_a = [], [], []
+        for _, rng in areas:
+            # Crowd gts are ignored regardless of area; area filtering
+            # ignores the rest outside the range (pycocotools _ignore).
+            g_ignore = g_crowd | (garea < rng[0]) | (garea > rng[1])
+            # Sort gt: non-ignored first (COCO matches real gt first).
+            g_order = np.argsort(g_ignore, kind="mergesort")
+            ious_a.append(ious[:, g_order])
+            ign_a.append(g_ignore[g_order])
+            crowd_a.append(g_crowd[g_order])
+        ign_a = np.stack(ign_a)
+        dt_match_a, _ = _greedy_match_batched(
+            np.stack(ious_a), ign_a, np.stack(crowd_a)
+        )
+        out = {}
+        for ai, (name, rng) in enumerate(areas):
+            dt_match, g_ignore = dt_match_a[ai], ign_a[ai]
+            # Unmatched dets outside the area range are ignored, matched-
+            # to-ignored-gt dets are ignored.
+            matched = dt_match > 0
+            matched_ignore = np.zeros_like(matched)
+            if g_ignore.size:
+                matched_ignore[matched] = g_ignore[dt_match[matched] - 1]
+            d_out = (darea < rng[0]) | (darea > rng[1])
+            out[name] = {
+                "scores": dscores,
+                "dt_match": dt_match,
+                "dt_ignore": np.where(matched, matched_ignore, d_out[None, :]),
+                "num_gt": int((~g_ignore).sum()),
+            }
+        return out
+
+    @staticmethod
+    def _accumulate(per_img: list, max_det: int):
         """→ (precision (T, R), recall (T,)) or None if no gt anywhere."""
-        per_img = [
-            r
-            for img in self._images
-            if (r := self._evaluate_img(cat, img, AREA_RANGES[area], max_det))
-        ]
         if not per_img:
             return None
         npos = sum(r["num_gt"] for r in per_img)
         if npos == 0:
             return None
-        scores = np.concatenate([r["scores"] for r in per_img])
+        scores = np.concatenate([r["scores"][:max_det] for r in per_img])
         order = np.argsort(-scores, kind="mergesort")
         T = len(IOU_THRS)
-        matches = np.concatenate([r["dt_match"] for r in per_img], axis=1)[:, order]
-        ignores = np.concatenate([r["dt_ignore"] for r in per_img], axis=1)[:, order]
+        matches = np.concatenate(
+            [r["dt_match"][:, :max_det] for r in per_img], axis=1
+        )[:, order]
+        ignores = np.concatenate(
+            [r["dt_ignore"][:, :max_det] for r in per_img], axis=1
+        )[:, order]
 
+        keep = ~ignores
+        tps = np.cumsum((matches > 0) & keep, axis=1)  # (T, D)
+        fps = np.cumsum((matches == 0) & keep, axis=1)
+        rc = tps / npos
+        pr = tps / np.maximum(tps + fps, 1e-10)
         precision = np.zeros((T, len(RECALL_THRS)))
-        recall = np.zeros(T)
+        recall = rc[:, -1] if rc.shape[1] else np.zeros(T)
+        # Monotone non-increasing precision envelope.
+        pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
         for ti in range(T):
-            keep = ~ignores[ti]
-            tps = np.cumsum((matches[ti] > 0) & keep)
-            fps = np.cumsum((matches[ti] == 0) & keep)
-            rc = tps / npos
-            pr = tps / np.maximum(tps + fps, 1e-10)
-            if len(rc):
-                recall[ti] = rc[-1]
-            # Monotone non-increasing precision envelope.
-            for i in range(len(pr) - 1, 0, -1):
-                pr[i - 1] = max(pr[i - 1], pr[i])
-            idx = np.searchsorted(rc, RECALL_THRS, side="left")
-            valid = idx < len(pr)
-            precision[ti, valid] = pr[idx[valid]]
+            idx = np.searchsorted(rc[ti], RECALL_THRS, side="left")
+            valid = idx < pr.shape[1]
+            precision[ti, valid] = pr[ti, idx[valid]]
         return precision, recall
 
     # -- summary -----------------------------------------------------------
 
     def summarize(self) -> dict[str, float]:
         cats = range(1, self.num_classes)
-        acc = {
-            (c, a, m): self._accumulate(c, a, m)
-            for c in cats
-            for a in AREA_RANGES
-            for m in MAX_DETS
-            if a == "all" or m == 100  # COCO only varies one of the two
-        }
+        iou_cache: dict = {}
+        acc: dict = {}
+        for c in cats:
+            by_area: dict[str, list] = {a: [] for a in AREA_RANGES}
+            for img in self._cat_images.get(c, ()):
+                r = self._evaluate_img(c, img, iou_cache)
+                if r:
+                    for a, rec in r.items():
+                        by_area[a].append(rec)
+            for a in AREA_RANGES:
+                # COCO only varies one of area / maxDet at a time.
+                for m in MAX_DETS if a == "all" else (MAX_DETS[-1],):
+                    acc[(c, a, m)] = self._accumulate(by_area[a], m)
 
         def mean_ap(area: str, max_det: int, iou_idx=None) -> float:
             vals = []

@@ -241,3 +241,65 @@ class TestCrowdIgnore:
             class_names=("bg", "thing"),
         )
         assert out["mAP"] == pytest.approx(1.0)
+
+
+class TestGreedyMatchVectorized:
+    def test_matches_reference_randomized(self):
+        from mx_rcnn_tpu.evalutil.coco_eval import (
+            _greedy_match,
+            _greedy_match_reference,
+        )
+
+        rng = np.random.RandomState(0)
+        for trial in range(400):
+            D = rng.randint(0, 12)
+            G = rng.randint(0, 10)
+            # Coarse quantization forces IoU ties so the last-tie-wins
+            # rule is actually exercised.
+            ious = rng.randint(0, 8, (D, G)) / 7.0
+            g_ignore = rng.rand(G) < 0.4
+            g_crowd = g_ignore & (rng.rand(G) < 0.5)
+            order = np.argsort(g_ignore, kind="mergesort")
+            ious = ious[:, order]
+            g_ignore, g_crowd = g_ignore[order], g_crowd[order]
+            ref = _greedy_match_reference(ious, g_ignore, g_crowd)
+            vec = _greedy_match(ious, g_ignore, g_crowd)
+            np.testing.assert_array_equal(vec[0], ref[0], err_msg=f"dt trial {trial}")
+            np.testing.assert_array_equal(vec[1], ref[1], err_msg=f"gt trial {trial}")
+
+    def test_full_evaluator_matches_reference_matcher(self, monkeypatch):
+        """End-to-end: the cached/area-batched/maxdet-sliced pipeline gives
+        the same 12 numbers as the literal pycocotools-style triple loop."""
+        import mx_rcnn_tpu.evalutil.coco_eval as ce
+
+        def build():
+            rng = np.random.RandomState(7)
+            ev = CocoEvaluator(num_classes=5)
+            for i in range(25):
+                G = rng.randint(0, 6)
+                D = rng.randint(0, 15)
+                gx = rng.uniform(0, 200, G); gy = rng.uniform(0, 200, G)
+                gw = rng.uniform(5, 120, G); gh = rng.uniform(5, 120, G)
+                gt = np.stack([gx, gy, gx + gw, gy + gh], 1).reshape(-1, 4)
+                gcls = rng.randint(1, 5, G)
+                crowd = rng.rand(G) < 0.3
+                idx = rng.randint(0, max(G, 1), D)
+                det = (gt[idx] if G else np.zeros((D, 4))) + rng.uniform(-25, 25, (D, 4))
+                dcls = rng.randint(1, 5, D)
+                ev.add_image(i, det, rng.rand(D), dcls, gt, gcls, gt_crowd=crowd)
+            return ev
+
+        fast = build().summarize()
+
+        def batched_via_reference(ious, g_ignore, g_crowd):
+            outs = [
+                ce._greedy_match_reference(ious[a], g_ignore[a], g_crowd[a])
+                for a in range(ious.shape[0])
+            ]
+            return np.stack([o[0] for o in outs]), np.stack([o[1] for o in outs])
+
+        monkeypatch.setattr(ce, "_greedy_match_batched", batched_via_reference)
+        slow = build().summarize()
+        assert fast.keys() == slow.keys()
+        for k in fast:
+            assert fast[k] == pytest.approx(slow[k], abs=1e-12), k
