@@ -21,7 +21,7 @@ from mx_rcnn_tpu.data.datasets import (
     VocDataset,
     build_dataset,
 )
-from mx_rcnn_tpu.data.loader import DetectionLoader
+from mx_rcnn_tpu.data.loader import DetectionLoader, load_image
 from mx_rcnn_tpu.data.roidb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
 
@@ -32,6 +32,7 @@ __all__ = [
     "VocDataset",
     "build_dataset",
     "filter_roidb",
+    "load_image",
     "letterbox",
     "merge_roidb",
     "normalize_image",

@@ -39,7 +39,7 @@ except Exception:  # pragma: no cover
 GT_MASK_SIZE = 112
 
 
-def _load_image(rec: RoiRecord) -> np.ndarray:
+def load_image(rec: RoiRecord) -> np.ndarray:
     """uint8 RGB from disk (float32 for in-memory synthetic images)."""
     if rec.image_array is not None:
         return rec.image_array
@@ -157,7 +157,7 @@ class DetectionLoader:
     # -- single image ------------------------------------------------------
 
     def _example(self, rec: RoiRecord, flip: bool):
-        img = _load_image(rec)
+        img = load_image(rec)
         boxes = rec.boxes
         if flip:
             img, boxes = hflip(img, boxes, rec.width)

@@ -133,7 +133,7 @@ def visualize_detections(
     import os
     import re
 
-    from mx_rcnn_tpu.data.loader import _load_image
+    from mx_rcnn_tpu.data import load_image
     from mx_rcnn_tpu.evalutil.masks import rle_decode
     from mx_rcnn_tpu.evalutil.vis import draw_detections
 
@@ -145,7 +145,7 @@ def visualize_detections(
         d = per_image.get(rec.image_id)
         if d is None:
             continue
-        image = _load_image(rec)
+        image = load_image(rec)
         masks = None
         if "masks" in d:
             masks = [

@@ -236,12 +236,16 @@ def train(
         )
         # Reproducibility: the exact resolved config next to its artifacts
         # (the reference leaves hyperparameters scattered across argparse
-        # defaults, the global config, and shell scripts).
+        # defaults, the global config, and shell scripts).  Written on
+        # fresh starts only, so every resume's drift check compares against
+        # the run-start original, not the previous resume's overrides —
+        # while a new run reusing the directory still replaces a stale one.
         import dataclasses as _dc
         import json as _json
 
-        with open(f"{workdir}/{cfg.name}/config.json", "w") as f:
-            _json.dump(_dc.asdict(cfg), f, indent=1)
+        if start == 0:
+            with open(f"{workdir}/{cfg.name}/config.json", "w") as f:
+                _json.dump(_dc.asdict(cfg), f, indent=1)
     # Device prefetch: the host->device copy of batch k+1 overlaps batch
     # k's step (12MB/image at 1024^2 — unhidden it costs more than the
     # fwd+bwd compute on a v5e).  Resumed runs fast-forward the loader so

@@ -52,13 +52,13 @@ class Speedometer:
 
     def __init__(self, batch_size: int) -> None:
         self.batch_size = batch_size
-        self._acc = MetricAccumulator()
         self._tic = time.monotonic()
         self._last_step: Optional[int] = None
 
     def __call__(self, step: int, metrics: dict) -> None:
-        self._acc.update(metrics)
-        parts = ", ".join(f"{k}={v:.4f}" for k, v in self._acc.summary().items())
+        # Metrics arrive once per log point, already per-call means under
+        # steps_per_call — format them directly, no accumulation.
+        parts = ", ".join(f"{k}={float(v):.4f}" for k, v in metrics.items())
         if self._last_step is None:
             log.info("step %d %s", step, parts)
         else:
@@ -67,7 +67,6 @@ class Speedometer:
             speed = delta * self.batch_size / max(elapsed, 1e-9)
             log.info("step %d speed %.2f samples/sec %s", step, speed, parts)
         self._last_step = step
-        self._acc.reset()
         self._tic = time.monotonic()
 
 
