@@ -20,6 +20,13 @@ no-op because all parameters already live in one pytree:
 Proposal dumps are written for artifact parity (the reference's rpn pkl);
 training itself consumes proposals in-graph from the frozen RPN, which keeps
 every phase a single statically-shaped jitted step.
+
+Documented deviation: the reference re-initializes each phase from the
+ImageNet params (its Fast R-CNN phases consume PRECOMPUTED pkl proposals,
+so resetting the trunk is safe).  Here the rcnn phases generate proposals
+in-graph from the frozen phase-1/3 RPN, whose head only matches the trunk
+it was trained on — so ``--pretrained`` seeds phase 1 and later phases
+continue from the previous phase's weights.
 """
 
 from __future__ import annotations
@@ -46,6 +53,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--no-proposal-dump", action="store_true",
         help="skip the pkl artifact dumps between phases",
     )
+    p.add_argument(
+        "--pretrained", default=None, metavar="PTH",
+        help="torchvision backbone .pth seeding phase 1. DEVIATION: the "
+        "reference re-seeds every phase from ImageNet; here later phases "
+        "continue from the previous phase because in-graph proposals need "
+        "the frozen RPN to match the trunk (see module docstring)",
+    )
     return p.parse_args(argv)
 
 
@@ -65,6 +79,7 @@ def alternate_train(
     workdir=None,
     dump_proposals_pkl: bool = True,
     num_phases: int = 4,
+    pretrained=None,
 ):
     """Run the 6-step schedule; returns the final combined TrainState.
 
@@ -103,6 +118,9 @@ def alternate_train(
             workdir=workdir,
             state=jax.device_get(state) if state is not None else None,
             extra_freeze=tuple(freeze),
+            # ImageNet seed applies to the fresh phase-1 state; later
+            # phases continue from the previous phase's weights.
+            pretrained=pretrained if state is None else None,
         )
     # combine_model parity: nothing to merge — one pytree holds RPN + RCNN.
     # Save the combined result under the BASE config name so eval/demo find
@@ -135,6 +153,7 @@ def main(argv=None):
         phase_steps=args.phase_steps,
         workdir=cfg.workdir,
         dump_proposals_pkl=not args.no_proposal_dump,
+        pretrained=args.pretrained,
     )
     from mx_rcnn_tpu.cli.eval_cli import run_eval
 

@@ -12,9 +12,21 @@ No network access is assumed anywhere — the file must already be on disk.
 
 from __future__ import annotations
 
+import logging
 from typing import Mapping
 
 import numpy as np
+
+log = logging.getLogger("mx_rcnn_tpu.import_torch")
+
+
+def _to_np(state_dict: Mapping, key: str) -> np.ndarray:
+    """Fetch a tensor as float32 numpy (torch tensors without importing
+    torch here)."""
+    v = state_dict[key]
+    if hasattr(v, "detach"):
+        v = v.detach().cpu().numpy()
+    return np.asarray(v, np.float32)
 
 
 def _conv_kernel(w: np.ndarray) -> np.ndarray:
@@ -27,10 +39,7 @@ def map_torch_resnet(state_dict: Mapping[str, "np.ndarray"]) -> tuple[dict, dict
     ``backbone`` module.  Accepts numpy arrays or torch tensors."""
 
     def arr(key: str) -> np.ndarray:
-        v = state_dict[key]
-        if hasattr(v, "detach"):  # torch tensor without importing torch here
-            v = v.detach().cpu().numpy()
-        return np.asarray(v, np.float32)
+        return _to_np(state_dict, key)
 
     params: dict = {}
     constants: dict = {}
@@ -91,9 +100,68 @@ def map_torch_resnet(state_dict: Mapping[str, "np.ndarray"]) -> tuple[dict, dict
     return params, constants
 
 
+_VGG16_CONV_LAYERS = (
+    # torchvision cfg-D `features` indices for the 13 convs, grouped.
+    (0, 2), (5, 7), (10, 12, 14), (17, 19, 21), (24, 26, 28),
+)
+
+
+def map_torch_vgg16(state_dict: Mapping[str, "np.ndarray"]) -> tuple[dict, dict]:
+    """torchvision VGG16 state_dict -> (backbone params, box-head params).
+
+    The trunk maps onto :class:`mx_rcnn_tpu.models.vgg.VGG16`
+    (``group{g}/conv{g}_{i}``); the ImageNet classifier's first two FCs map
+    onto the box head's ``fc6``/``fc7`` — the reference's VGG recipe seeds
+    those from the pretrained net too (``rcnn/symbol/symbol_vgg.py``
+    get_vgg_rcnn reuses fc6/fc7; load_param pulls them from the ImageNet
+    ``.params``), which the VOC mAP baseline depends on.
+    """
+
+    def arr(key: str) -> np.ndarray:
+        return _to_np(state_dict, key)
+
+    # Validate the cfg-D (vgg16, no BN) layout up front so a vgg16_bn /
+    # vgg11 / vgg13 file fails with an architecture error instead of an
+    # opaque transpose/KeyError deep in the mapping.
+    for layers in _VGG16_CONV_LAYERS:
+        for idx in layers:
+            k = f"features.{idx}.weight"
+            v = state_dict.get(k)
+            if v is None or len(getattr(v, "shape", ())) != 4:
+                raise ValueError(
+                    "unsupported torchvision VGG variant: expected vgg16 "
+                    f"(cfg D, no BN); {k} missing or not a conv kernel"
+                )
+
+    params: dict = {}
+    for g, layers in enumerate(_VGG16_CONV_LAYERS):
+        group: dict = {}
+        for i, idx in enumerate(layers):
+            group[f"conv{g + 1}_{i + 1}"] = {
+                "kernel": _conv_kernel(arr(f"features.{idx}.weight")),
+                "bias": arr(f"features.{idx}.bias"),
+            }
+        params[f"group{g + 1}"] = group
+
+    head: dict = {}
+    if "classifier.0.weight" in state_dict:
+        # fc6 consumes the flattened pool: torch flattens (C, H, W), the
+        # flax box head flattens (H, W, C) pooled rois — permute fc6's
+        # input axis accordingly.  512x7x7 is fixed by the architecture.
+        w6 = arr("classifier.0.weight")          # (4096, 25088) CHW-major
+        w6 = w6.reshape(-1, 512, 7, 7).transpose(0, 2, 3, 1).reshape(w6.shape[0], -1)
+        head["fc6"] = {"kernel": w6.T, "bias": arr("classifier.0.bias")}
+        head["fc7"] = {
+            "kernel": arr("classifier.3.weight").T,
+            "bias": arr("classifier.3.bias"),
+        }
+    return params, head
+
+
 def load_pretrained_backbone(variables: dict, pth_path: str) -> dict:
-    """Return a copy of ``variables`` with the backbone initialized from a
-    torchvision ResNet ``.pth`` state_dict on disk.
+    """Return a copy of ``variables`` with the backbone (and, for VGG, the
+    box head's fc6/fc7) initialized from a torchvision ``.pth`` state_dict
+    on disk.
 
     The reference's ``load_param`` + arg/aux-dict surgery, flax style: only
     keys present in both trees are replaced; shapes are validated.
@@ -103,7 +171,12 @@ def load_pretrained_backbone(variables: dict, pth_path: str) -> dict:
     sd = torch.load(pth_path, map_location="cpu", weights_only=True)
     if hasattr(sd, "state_dict"):
         sd = sd.state_dict()
-    params_in, constants_in = map_torch_resnet(sd)
+    if "features.0.weight" in sd:  # torchvision VGG layout
+        params_in, head_in = map_torch_vgg16(sd)
+        constants_in = {}  # VGG-16: no BN
+    else:
+        params_in, constants_in = map_torch_resnet(sd)
+        head_in = {}
 
     out = {k: dict(v) for k, v in variables.items()}
     consumed = [0]
@@ -129,6 +202,31 @@ def load_pretrained_backbone(variables: dict, pth_path: str) -> dict:
     out["params"]["backbone"] = merge(
         out["params"]["backbone"], params_in, "params/backbone"
     )
+    if head_in and "box_head" in out["params"]:
+
+        def head_shapes_match() -> bool:
+            dst = out["params"]["box_head"]
+            return all(
+                name in dst
+                and tuple(np.asarray(dst[name][p]).shape) == tuple(v[p].shape)
+                for name, v in head_in.items()
+                for p in v
+            )
+
+        if head_shapes_match():
+            out["params"]["box_head"] = merge(
+                out["params"]["box_head"], head_in, "params/box_head"
+            )
+        else:
+            # Head dims differ from the ImageNet classifier (e.g.
+            # hidden_dim != 4096): keep the model's random init, like the
+            # reference does for its non-VGG heads.  Loud: the VOC mAP
+            # baseline depends on seeded fc6/fc7.
+            log.warning(
+                "box head dims differ from the VGG classifier "
+                "(hidden_dim != 4096 or pooled != 7x7x512); fc6/fc7 keep "
+                "random init — expect lower VOC mAP than the baseline"
+            )
     if "constants" in out:
         out["constants"] = dict(out["constants"])
         out["constants"]["backbone"] = merge(

@@ -2,5 +2,7 @@
 # BASELINE config #1: Faster R-CNN VGG-16, PASCAL VOC 2007 trainval,
 # 4-step alternate training (reference: script/vgg_voc07.sh + train_alternate.py).
 set -ex
-python train_alternate.py --config vgg16_voc07 --workdir runs "$@"
+# ImageNet VGG-16 init (torchvision vgg16-*.pth on disk; reference: --pretrained imagenet)
+: "${VGG_PTH:?set VGG_PTH to a torchvision vgg16 .pth}"
+python train_alternate.py --config vgg16_voc07 --workdir runs --pretrained "$VGG_PTH" "$@"
 python test.py --config vgg16_voc07 --workdir runs --use-07-metric "$@"

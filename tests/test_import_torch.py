@@ -105,3 +105,143 @@ class TestMapping:
         sd = _fake_torchvision_sd(blocks=STAGE_BLOCKS["resnet101"])
         params, _ = map_torch_resnet(sd)
         assert "layer3_block22" in params
+
+
+def _fake_torchvision_vgg16_sd(rng=None, with_classifier=True):
+    """Random state_dict with torchvision vgg16 (cfg D) key names/shapes."""
+    rng = rng or np.random.RandomState(0)
+    sd = {}
+    cin = 3
+    # conv indices of torchvision's `features` Sequential for cfg D.
+    for idx, cout in zip(
+        (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28),
+        (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512),
+    ):
+        sd[f"features.{idx}.weight"] = torch.tensor(
+            rng.randn(cout, cin, 3, 3).astype(np.float32) * 0.05
+        )
+        sd[f"features.{idx}.bias"] = torch.tensor(
+            rng.randn(cout).astype(np.float32) * 0.1
+        )
+        cin = cout
+    if with_classifier:
+        sd["classifier.0.weight"] = torch.tensor(
+            rng.randn(4096, 512 * 7 * 7).astype(np.float32) * 0.01
+        )
+        sd["classifier.0.bias"] = torch.tensor(
+            rng.randn(4096).astype(np.float32) * 0.1
+        )
+        sd["classifier.3.weight"] = torch.tensor(
+            rng.randn(4096, 4096).astype(np.float32) * 0.01
+        )
+        sd["classifier.3.bias"] = torch.tensor(
+            rng.randn(4096).astype(np.float32) * 0.1
+        )
+    return sd
+
+
+class TestVggMapping:
+    def test_full_tree_and_forward_changes(self, tmp_path):
+        from mx_rcnn_tpu.models.vgg import VGG16
+        from mx_rcnn_tpu.train.import_torch import map_torch_vgg16
+
+        sd = _fake_torchvision_vgg16_sd()
+        model = VGG16(dtype=jnp.float32)
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 64, 64, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+
+        params, head = map_torch_vgg16(sd)
+        assert set(params) == set(variables["params"])
+        for g in range(1, 6):
+            assert set(params[f"group{g}"]) == set(variables["params"][f"group{g}"])
+        assert set(head) == {"fc6", "fc7"}
+
+        pth = str(tmp_path / "fake_vgg16.pth")
+        torch.save(sd, pth)
+        wrapped = {"params": {"backbone": variables["params"]}}
+        loaded = load_pretrained_backbone(wrapped, pth)
+        np.testing.assert_allclose(
+            loaded["params"]["backbone"]["group1"]["conv1_1"]["kernel"],
+            np.transpose(sd["features.0.weight"].numpy(), (2, 3, 1, 0)),
+        )
+        out_init = model.apply(variables, x)
+        out_load = model.apply({"params": loaded["params"]["backbone"]}, x)
+        assert not np.allclose(np.asarray(out_init[4]), np.asarray(out_load[4]))
+        assert np.isfinite(np.asarray(out_load[4])).all()
+
+    def test_fc6_permutation_matches_torch_flatten(self):
+        """fc6 on flax HWC-flattened rois == torch fc6 on CHW-flattened."""
+        from mx_rcnn_tpu.train.import_torch import map_torch_vgg16
+
+        sd = _fake_torchvision_vgg16_sd()
+        _, head = map_torch_vgg16(sd)
+        pooled = np.random.RandomState(2).rand(2, 7, 7, 512).astype(np.float32)
+        # torch: flatten (C, H, W)
+        x_chw = pooled.transpose(0, 3, 1, 2).reshape(2, -1)
+        ref = x_chw @ sd["classifier.0.weight"].numpy().T + sd[
+            "classifier.0.bias"
+        ].numpy()
+        got = pooled.reshape(2, -1) @ head["fc6"]["kernel"] + head["fc6"]["bias"]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_head_seeding_into_box_head(self, tmp_path):
+        from mx_rcnn_tpu.models.heads import BoxHead
+
+        sd = _fake_torchvision_vgg16_sd()
+        pth = str(tmp_path / "fake_vgg16.pth")
+        torch.save(sd, pth)
+        head = BoxHead(num_classes=21, hidden_dim=4096, dtype=jnp.float32)
+        hv = head.init(jax.random.PRNGKey(0), jnp.zeros((2, 7, 7, 512)))
+        from mx_rcnn_tpu.models.vgg import VGG16
+
+        bb = VGG16(dtype=jnp.float32).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+        )
+        wrapped = {
+            "params": {"backbone": bb["params"], "box_head": hv["params"]}
+        }
+        loaded = load_pretrained_backbone(wrapped, pth)
+        got = np.asarray(loaded["params"]["box_head"]["fc7"]["kernel"])
+        np.testing.assert_allclose(got, sd["classifier.3.weight"].numpy().T)
+        # cls_score/bbox_pred untouched (no ImageNet counterpart).
+        np.testing.assert_allclose(
+            np.asarray(loaded["params"]["box_head"]["cls_score"]["kernel"]),
+            np.asarray(hv["params"]["cls_score"]["kernel"]),
+        )
+
+    def test_mismatched_head_skipped_not_fatal(self, tmp_path):
+        from mx_rcnn_tpu.models.heads import BoxHead
+        from mx_rcnn_tpu.models.vgg import VGG16
+
+        sd = _fake_torchvision_vgg16_sd()
+        pth = str(tmp_path / "fake_vgg16.pth")
+        torch.save(sd, pth)
+        head = BoxHead(num_classes=21, hidden_dim=1024, dtype=jnp.float32)
+        hv = head.init(jax.random.PRNGKey(0), jnp.zeros((2, 7, 7, 512)))
+        bb = VGG16(dtype=jnp.float32).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+        )
+        wrapped = {
+            "params": {"backbone": bb["params"], "box_head": hv["params"]}
+        }
+        loaded = load_pretrained_backbone(wrapped, pth)  # must not raise
+        np.testing.assert_allclose(
+            np.asarray(loaded["params"]["box_head"]["fc6"]["kernel"]),
+            np.asarray(hv["params"]["fc6"]["kernel"]),
+        )
+
+    def test_non_cfgd_vgg_rejected(self, tmp_path):
+        """vgg16_bn-style layouts fail with an architecture error, not a
+        transpose/KeyError."""
+        sd = _fake_torchvision_vgg16_sd()
+        # Simulate BN interleaving: features.2 becomes a 1-D BN weight.
+        sd["features.2.weight"] = torch.zeros(64)
+        pth = str(tmp_path / "vgg16_bn.pth")
+        torch.save(sd, pth)
+        from mx_rcnn_tpu.models.vgg import VGG16
+
+        bb = VGG16(dtype=jnp.float32).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+        )
+        with pytest.raises(ValueError, match="VGG variant"):
+            load_pretrained_backbone({"params": {"backbone": bb["params"]}}, pth)
