@@ -35,6 +35,20 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="dump RPN proposals per image instead of evaluating (test_rpn parity)",
     )
     p.add_argument(
+        "--from-proposals",
+        default=None,
+        metavar="IN.PKL",
+        help="score this external proposal pkl instead of running the RPN "
+        "(Fast R-CNN testing; reference: test_rcnn --has_rpn false)",
+    )
+    p.add_argument(
+        "--proposals-split",
+        choices=("train", "val"),
+        default=None,
+        help="which split --proposals dumps (default val; train: the Fast "
+        "R-CNN training input; reference rpn.generate over TRAIN.dataset)",
+    )
+    p.add_argument(
         "--use-07-metric", action="store_true", help="VOC 11-point AP metric"
     )
     p.add_argument(
@@ -45,13 +59,24 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def _eval_loader(cfg: Config, batch_size: int = 1, with_masks: bool = False):
+def _eval_loader(
+    cfg: Config,
+    batch_size: int = 1,
+    with_masks: bool = False,
+    proposals_path: Optional[str] = None,
+):
     from mx_rcnn_tpu.data import DetectionLoader, build_dataset
 
+    proposals = None
+    if proposals_path:
+        with open(proposals_path, "rb") as f:
+            proposals = pickle.load(f)
     roidb = build_dataset(cfg.data, train=False).roidb()
     loader = DetectionLoader(
         roidb, cfg.data, batch_size=batch_size, train=False,
         with_masks=with_masks,
+        proposals=proposals,
+        num_proposals=cfg.model.rpn.test_post_nms_top_n,
     )
     return roidb, loader
 
@@ -83,8 +108,12 @@ def run_eval(
     dump_path: Optional[str] = None,
     use_07_metric: bool = False,
     vis_count: int = 0,
+    proposals_path: Optional[str] = None,
 ) -> dict:
-    """Evaluate a state (or a restored checkpoint) on the config's val split."""
+    """Evaluate a state (or a restored checkpoint) on the config's val split.
+
+    ``proposals_path``: score an external proposal pkl instead of running
+    the RPN (reference ``test_rcnn --has_rpn false`` Fast R-CNN testing)."""
     import jax
 
     from mx_rcnn_tpu.detection import TwoStageDetector
@@ -119,7 +148,9 @@ def run_eval(
     )
     per_chip = max(cfg.model.test.per_device_batch, 1)
     roidb, loader = _eval_loader(
-        cfg, batch_size=(mesh.size if mesh is not None else 1) * per_chip
+        cfg,
+        batch_size=(mesh.size if mesh is not None else 1) * per_chip,
+        proposals_path=proposals_path,
     )
     style = "voc" if cfg.data.dataset == "voc" else "coco"
     class_names = None
@@ -199,10 +230,16 @@ def main(argv=None) -> dict:
     args = parse_args(argv)
     setup_logging(args.verbose)
     cfg = config_from_args(args)
+    if args.proposals and args.from_proposals:
+        raise SystemExit(
+            "--proposals (dump) and --from-proposals (score) are exclusive"
+        )
+    if args.proposals_split and not args.proposals:
+        raise SystemExit("--proposals-split only applies with --proposals")
     if args.proposals:
         return dump_proposals(
             cfg, args.proposals, ckpt_dir=args.ckpt, step=args.step,
-            train_split=False,
+            train_split=args.proposals_split == "train",
         )
     return run_eval(
         cfg,
@@ -211,6 +248,7 @@ def main(argv=None) -> dict:
         dump_path=args.dump,
         use_07_metric=args.use_07_metric,
         vis_count=args.vis,
+        proposals_path=args.from_proposals,
     )
 
 

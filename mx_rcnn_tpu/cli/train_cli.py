@@ -35,6 +35,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="torchvision-style ResNet .pth to seed the backbone "
         "(reference: --pretrained imagenet params)",
     )
+    p.add_argument(
+        "--proposals", default=None, metavar="PKL",
+        help="train the box head on this external proposal pkl (from "
+        "test.py --proposals) instead of in-graph RPN proposals — Fast "
+        "R-CNN mode (reference: train_rcnn.py/ROIIter).  Pair with --set "
+        "model.rpn.loss_weight=0 to drop the RPN from the graph entirely",
+    )
     return p.parse_args(argv)
 
 
@@ -66,6 +73,7 @@ def main(argv=None) -> dict:
         resume=args.resume,
         profile_dir=args.profile,
         pretrained=args.pretrained,
+        proposals_path=args.proposals,
     )
     metrics: dict = {"final_step": int(jax.device_get(state.step))}
     if not args.no_eval:

@@ -22,6 +22,7 @@ import numpy as np
 from mx_rcnn_tpu.config import DataConfig
 from mx_rcnn_tpu.data.roidb import RoiRecord
 from mx_rcnn_tpu.data.transforms import (
+    flip_boxes,
     hflip,
     letterbox,
     normalize_image,
@@ -107,7 +108,14 @@ class DetectionLoader:
         with_masks: bool = False,
         prefetch: bool = True,
         num_workers: Optional[int] = None,
+        proposals: Optional[dict] = None,
+        num_proposals: int = 1000,
     ) -> None:
+        """``proposals``: image_id → {"boxes": (n, 4) ORIGINAL-image coords,
+        "scores": (n,)} (the ``test.py --proposals`` pkl format) — shipped
+        per batch as score-ordered, letterbox-scaled, padded ext_rois for
+        Fast R-CNN training/testing (reference ``ROIIter``).  Boxes are
+        truncated/padded to the static ``num_proposals``."""
         self.roidb = list(roidb[rank::world]) if world > 1 else list(roidb)
         self.cfg = cfg
         self.batch_size = batch_size
@@ -124,6 +132,15 @@ class DetectionLoader:
             cores = _os.cpu_count() or 1
             num_workers = min(8, cores) if cores > 1 else 0
         self.num_workers = num_workers if train else 0
+        self.proposals = proposals
+        self.num_proposals = num_proposals
+        if proposals is not None:
+            missing = [r.image_id for r in self.roidb if r.image_id not in proposals]
+            if missing:
+                raise ValueError(
+                    f"{len(missing)} roidb image(s) have no proposals "
+                    f"(first: {missing[0]!r})"
+                )
         if not self.roidb:
             raise ValueError("empty roidb shard")
         # Datasets without any ignore regions ship gt_ignore=None so the
@@ -210,12 +227,33 @@ class DetectionLoader:
                         continue
                     m = _rasterize_mask(rec.masks[i], rec.boxes[i])
                     masks[i] = m[:, ::-1] if flip else m
-        return img, (th, tw), gt_boxes, gt_classes, gt_valid, gt_ignore, masks, scale
+        ext = None
+        if self.proposals is not None:
+            # External proposals ride the exact same geometry as gt boxes:
+            # flip in original coords, then the letterbox scale.
+            p = self.proposals[rec.image_id]
+            pb = np.asarray(p["boxes"], np.float32).reshape(-1, 4)
+            ps = np.asarray(p["scores"], np.float32).reshape(len(pb))
+            if flip:
+                pb = flip_boxes(pb, rec.width)
+            order = np.argsort(-ps, kind="mergesort")[: self.num_proposals]
+            pb = pb[order] * scale
+            np.clip(pb[:, 0::2], 0.0, tw - 1.0, out=pb[:, 0::2])
+            np.clip(pb[:, 1::2], 0.0, th - 1.0, out=pb[:, 1::2])
+            ext_rois = np.zeros((self.num_proposals, 4), np.float32)
+            ext_valid = np.zeros((self.num_proposals,), bool)
+            ext_rois[: len(pb)] = pb
+            ext_valid[: len(pb)] = True
+            ext = (ext_rois, ext_valid)
+        return (
+            img, (th, tw), gt_boxes, gt_classes, gt_valid, gt_ignore, masks,
+            ext, scale,
+        )
 
     def _assemble(self, recs: list[RoiRecord], flips: list[bool]) -> Batch:
-        ims, hws, bs, cs, vs, igs, ms = [], [], [], [], [], [], []
+        ims, hws, bs, cs, vs, igs, ms, ers, evs = [], [], [], [], [], [], [], [], []
         for rec, fl in zip(recs, flips):
-            img, (th, tw), gb, gc, gv, gi, gm, _ = self._example(rec, fl)
+            img, (th, tw), gb, gc, gv, gi, gm, ext, _ = self._example(rec, fl)
             ims.append(img)
             hws.append([th, tw])
             bs.append(gb)
@@ -224,6 +262,9 @@ class DetectionLoader:
             igs.append(gi)
             if gm is not None:
                 ms.append(gm)
+            if ext is not None:
+                ers.append(ext[0])
+                evs.append(ext[1])
         return Batch(
             images=np.stack(ims),
             image_hw=np.asarray(hws, np.float32),
@@ -232,6 +273,8 @@ class DetectionLoader:
             gt_valid=np.stack(vs),
             gt_masks=np.stack(ms) if ms else None,
             gt_ignore=np.stack(igs) if self.with_ignore else None,
+            ext_rois=np.stack(ers) if ers else None,
+            ext_valid=np.stack(evs) if evs else None,
         )
 
     # -- iteration ---------------------------------------------------------

@@ -64,11 +64,16 @@ def normalize_image(
     return (image - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
 
 
-def hflip(image: np.ndarray, boxes: np.ndarray, width: int):
-    """Horizontal flip of pixels + boxes (reference: flipped roidb entries
-    remap x1,x2 = w-1-x2, w-1-x1 at batch time)."""
-    out = image[:, ::-1].copy()
+def flip_boxes(boxes: np.ndarray, width: int) -> np.ndarray:
+    """Horizontal box remap, the reference's flipped-roidb convention:
+    x1, x2 = w-1-x2, w-1-x1."""
     fb = boxes.copy()
     fb[:, 0] = width - 1 - boxes[:, 2]
     fb[:, 2] = width - 1 - boxes[:, 0]
-    return out, fb
+    return fb
+
+
+def hflip(image: np.ndarray, boxes: np.ndarray, width: int):
+    """Horizontal flip of pixels + boxes (reference: flipped roidb entries
+    remap x1,x2 = w-1-x2, w-1-x1 at batch time)."""
+    return image[:, ::-1].copy(), flip_boxes(boxes, width)

@@ -54,6 +54,11 @@ class Batch(NamedTuple):
     # COCO crowd / VOC difficult regions: never fg, and anchors/rois covering
     # them are excluded from bg sampling.  Disjoint from gt_valid slots.
     gt_ignore: Optional[jnp.ndarray] = None  # (B, G) bool
+    # Externally supplied proposals in letterboxed-image coords, score-desc,
+    # padded (Fast R-CNN mode — the reference's ROIIter/train_rcnn path,
+    # ``rcnn/core/loader.py::ROIIter``).  None = in-graph RPN proposals.
+    ext_rois: Optional[jnp.ndarray] = None   # (B, R, 4)
+    ext_valid: Optional[jnp.ndarray] = None  # (B, R) bool
 
 
 class Detections(NamedTuple):
@@ -356,13 +361,6 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     """
     cfg = model.cfg
     feats = model.apply(variables, batch.images, method="features")
-    rpn_out = model.apply(variables, feats, method="rpn")
-
-    anchors = level_anchors(cfg, feats)
-    levels = sorted(rpn_out)
-    logits_cat = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)  # (B, A)
-    deltas_cat = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)  # (B, A, 4)
-    anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)    # (A, 4)
 
     b = batch.images.shape[0]
     rng_assign, rng_sample = jax.random.split(rng)
@@ -372,30 +370,49 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     # computation entirely).
     gt_ignore = batch.gt_ignore
     gi_axis = 0 if gt_ignore is not None else None
-    targets = jax.vmap(
-        lambda k, gt, gv, gi, hw: assign_anchors_cfg(
-            cfg, k, anchors_cat, gt, gv, hw[0], hw[1], gt_ignore=gi
-        ),
-        in_axes=(0, 0, 0, gi_axis, 0),
-    )(
-        jax.random.split(rng_assign, b),
-        batch.gt_boxes,
-        batch.gt_valid,
-        gt_ignore,
-        batch.image_hw,
-    )
 
-    rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
+    use_ext = batch.ext_rois is not None
+    if use_ext and cfg.rpn.loss_weight == 0.0:
+        # Fast R-CNN mode (reference ``rcnn/tools/train_rcnn.py``): the box
+        # head trains on externally supplied proposals and the RPN never
+        # enters the graph — no head apply, no anchor labeling, no losses.
+        rpn_cls = rpn_box = rpn_acc = jnp.zeros((), jnp.float32)
+    else:
+        rpn_out = model.apply(variables, feats, method="rpn")
+        anchors = level_anchors(cfg, feats)
+        levels = sorted(rpn_out)
+        logits_cat = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)
+        deltas_cat = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)
+        anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)
 
-    # Proposals are detached: the reference never backprops through the
-    # Proposal op either (CustomOp forward-only); gradients reach the RPN
-    # exclusively through its losses.
-    scores = jax.nn.sigmoid(lax.stop_gradient(logits_cat))
-    deltas_sg = lax.stop_gradient(deltas_cat)
-    propose = _propose_one(cfg, train=True)
-    props = jax.vmap(
-        lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
-    )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
+        targets = jax.vmap(
+            lambda k, gt, gv, gi, hw: assign_anchors_cfg(
+                cfg, k, anchors_cat, gt, gv, hw[0], hw[1], gt_ignore=gi
+            ),
+            in_axes=(0, 0, 0, gi_axis, 0),
+        )(
+            jax.random.split(rng_assign, b),
+            batch.gt_boxes,
+            batch.gt_valid,
+            gt_ignore,
+            batch.image_hw,
+        )
+
+        rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
+
+    if use_ext:
+        prop_rois, prop_valid = batch.ext_rois, batch.ext_valid
+    else:
+        # Proposals are detached: the reference never backprops through the
+        # Proposal op either (CustomOp forward-only); gradients reach the
+        # RPN exclusively through its losses.
+        scores = jax.nn.sigmoid(lax.stop_gradient(logits_cat))
+        deltas_sg = lax.stop_gradient(deltas_cat)
+        propose = _propose_one(cfg, train=True)
+        props = jax.vmap(
+            lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
+        )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
+        prop_rois, prop_valid = props.rois, props.valid
 
     samples = jax.vmap(
         lambda k, rois, rv, gt, gc, gv, gi: sample_rois(
@@ -411,8 +428,8 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
     )(
         jax.random.split(rng_sample, b),
-        props.rois,
-        props.valid,
+        prop_rois,
+        prop_valid,
         batch.gt_boxes,
         batch.gt_classes.astype(jnp.int32),
         batch.gt_valid,
@@ -491,7 +508,16 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detec
     """
     cfg = model.cfg
     feats = model.apply(variables, batch.images, method="features")
-    props = _propose_on_features(model, variables, feats, batch)
+    if batch.ext_rois is not None:
+        # Fast R-CNN test mode (reference ``test_rcnn --has_rpn false``):
+        # score externally supplied proposals; the RPN never runs.
+        props = Proposals(
+            rois=batch.ext_rois,
+            scores=jnp.zeros(batch.ext_valid.shape, jnp.float32),
+            valid=batch.ext_valid,
+        )
+    else:
+        props = _propose_on_features(model, variables, feats, batch)
 
     pooled = _pool_rois(cfg, feats, props.rois, cfg.rcnn.pooled_size, model.roi_levels)
     s = cfg.rcnn.pooled_size

@@ -111,7 +111,7 @@ def make_train_step(
     batch_shardings = Batch(
         images=img,
         image_hw=data, gt_boxes=data, gt_classes=data, gt_valid=data,
-        gt_masks=data, gt_ignore=data,
+        gt_masks=data, gt_ignore=data, ext_rois=data, ext_valid=data,
     )
     return jax.jit(
         fn,

@@ -184,11 +184,14 @@ def train(
     profile_dir: Optional[str] = None,
     profile_steps: tuple[int, int] = (10, 15),
     pretrained: Optional[str] = None,
+    proposals_path: Optional[str] = None,
 ) -> TrainState:
     """Train for ``total_steps`` (default: cfg schedule length); returns the
     final state (host-fetchable).  Pass ``state`` to continue from an earlier
     phase (alternate training), ``resume`` to restore from workdir;
-    ``profile_dir`` traces steps ``profile_steps`` into it (jax.profiler)."""
+    ``profile_dir`` traces steps ``profile_steps`` into it (jax.profiler);
+    ``proposals_path`` trains the box head on an external proposal pkl
+    (Fast R-CNN mode — reference ``rcnn/tools/train_rcnn.py``)."""
     if mesh is None and jax.device_count() > 1:
         mesh = make_mesh(model_parallel=cfg.train.spatial_partition)
     model, tx, fresh_state, step_fn, global_batch = build_all(
@@ -213,6 +216,12 @@ def train(
         _warn_config_drift(cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json")
 
     if loader is None:
+        proposals = None
+        if proposals_path:
+            import pickle
+
+            with open(proposals_path, "rb") as f:
+                proposals = pickle.load(f)
         roidb = filter_roidb(build_dataset(cfg.data, train=True).roidb())
         loader = DetectionLoader(
             roidb,
@@ -223,6 +232,8 @@ def train(
             rank=jax.process_index(),
             world=jax.process_count(),
             with_masks=cfg.model.mask.enabled,
+            proposals=proposals,
+            num_proposals=cfg.model.rpn.train_post_nms_top_n,
         )
     if mesh is not None:
         state = jax.device_put(state, replicated(mesh))

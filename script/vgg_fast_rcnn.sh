@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Fast R-CNN mode (reference: script/vgg_fast_rcnn.sh → train_rcnn.py with
+# ROIIter): train the box head on a FIXED external proposal set — no RPN in
+# the train graph — then score the val-split proposals with the result.
+#
+# The proposal pkls come from any trained RPN checkpoint (test.py
+# --proposals) or from an external source (e.g. selective search) converted
+# to the same format: image_id → {"boxes": (n,4) original coords, "scores"}.
+set -ex
+: "${VGG_PTH:?set VGG_PTH to a torchvision vgg16 .pth}"
+
+# 1) dump proposals over both splits from an existing RPN checkpoint
+#    (e.g. after train_alternate phase 1, or any trained vgg16_voc07 run).
+python test.py --config vgg16_voc07 --workdir runs \
+  --proposals runs/vgg16_voc07/proposals_train.pkl --proposals-split train "$@"
+python test.py --config vgg16_voc07 --workdir runs \
+  --proposals runs/vgg16_voc07/proposals_val.pkl --proposals-split val "$@"
+
+# 2) Fast R-CNN training on the train-split pkl (RPN dropped from the graph;
+#    ImageNet seed for trunk + fc6/fc7 as in the reference recipe).
+python train.py --config vgg16_voc07 --workdir runs --no-eval \
+  --pretrained "$VGG_PTH" \
+  --set model.rpn.loss_weight=0 \
+  --proposals runs/vgg16_voc07/proposals_train.pkl "$@"
+
+# 3) Fast R-CNN testing: score the val-split proposals (no RPN at test).
+python test.py --config vgg16_voc07 --workdir runs --use-07-metric \
+  --from-proposals runs/vgg16_voc07/proposals_val.pkl "$@"

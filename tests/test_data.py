@@ -242,3 +242,86 @@ class TestWorkerPool:
             np.testing.assert_array_equal(a.images, b.images)
             np.testing.assert_array_equal(a.gt_boxes, b.gt_boxes)
             np.testing.assert_array_equal(a.gt_valid, b.gt_valid)
+
+
+class TestExternalProposals:
+    def _loader(self, rng, proposals, train=True, num=8, flip=False):
+        import dataclasses
+
+        from mx_rcnn_tpu.config import get_config
+
+        cfg = dataclasses.replace(
+            get_config("tiny_synthetic").data, flip=flip
+        )
+        recs = [
+            RoiRecord(
+                image_id="a", image_path="", height=64, width=96,
+                boxes=np.array([[10, 10, 40, 40]], np.float32),
+                gt_classes=np.array([1], np.int32),
+                image_array=(rng.rand(64, 96, 3) * 255).astype(np.float32),
+            )
+        ]
+        return DetectionLoader(
+            recs, cfg, batch_size=1, train=train, prefetch=False,
+            proposals=proposals, num_proposals=num, num_workers=0,
+        )
+
+    def test_scaled_ordered_padded(self, rng):
+        props = {
+            "a": {
+                "boxes": np.array(
+                    [[0, 0, 10, 10], [20, 20, 50, 50], [5, 5, 30, 30]],
+                    np.float32,
+                ),
+                "scores": np.array([0.2, 0.9, 0.5], np.float32),
+            }
+        }
+        loader = self._loader(rng, props, train=False)
+        batch, _ = next(iter(loader))
+        assert batch.ext_rois.shape == (1, 8, 4)
+        scale = loader.record_scale(loader.roidb[0])
+        # Score-descending order, letterbox-scaled.
+        np.testing.assert_allclose(
+            batch.ext_rois[0, 0], np.array([20, 20, 50, 50]) * scale, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            batch.ext_rois[0, 1], np.array([5, 5, 30, 30]) * scale, rtol=1e-5
+        )
+        assert batch.ext_valid[0].sum() == 3
+        assert (batch.ext_rois[0, 3:] == 0).all()
+
+    def test_truncates_to_top_scores(self, rng):
+        boxes = np.stack(
+            [np.array([i, i, i + 10, i + 10], np.float32) for i in range(20)]
+        )
+        scores = np.linspace(0, 1, 20).astype(np.float32)
+        loader = self._loader(
+            rng, {"a": {"boxes": boxes, "scores": scores}}, train=False
+        )
+        batch, _ = next(iter(loader))
+        assert batch.ext_valid[0].all()  # 8 slots, 20 candidates
+        scale = loader.record_scale(loader.roidb[0])
+        # Highest-scored box (i=19) first.
+        np.testing.assert_allclose(
+            batch.ext_rois[0, 0], np.array([19, 19, 29, 29]) * scale, rtol=1e-5
+        )
+
+    def test_flip_matches_gt_flip(self, rng):
+        # With flip forced on, proposals identical to the gt box must land
+        # exactly on the flipped+scaled gt coordinates.
+        props = {
+            "a": {
+                "boxes": np.array([[10, 10, 40, 40]], np.float32),
+                "scores": np.array([1.0], np.float32),
+            }
+        }
+        loader = self._loader(rng, props, train=True, flip=True)
+        # Force the flip draw deterministically: assemble directly.
+        batch = loader._assemble([loader.roidb[0]], [True])
+        np.testing.assert_allclose(
+            batch.ext_rois[0, 0], batch.gt_boxes[0, 0], rtol=1e-5
+        )
+
+    def test_missing_proposals_rejected(self, rng):
+        with pytest.raises(ValueError, match="no proposals"):
+            self._loader(rng, {"other": {}})

@@ -142,3 +142,101 @@ class TestInference:
         batch = tiny_batch(rng)
         dets = jax.jit(lambda v, b: forward_inference(model, v, b))(variables, batch)
         assert dets.boxes.shape[0] == batch.images.shape[0]
+
+
+class TestExternalProposals:
+    """Fast R-CNN mode: Batch.ext_rois replaces in-graph RPN proposals
+    (reference ROIIter/train_rcnn + test_rcnn --has_rpn false)."""
+
+    def _with_ext(self, rng, batch, r=64):
+        b = batch.images.shape[0]
+        # Proposals = jittered copies of the gt boxes + noise boxes.
+        rois = np.zeros((b, r, 4), np.float32)
+        valid = np.zeros((b, r), bool)
+        gt = np.asarray(batch.gt_boxes)
+        for i in range(b):
+            n = 0
+            for j in range(3):
+                for _ in range(8):
+                    rois[i, n] = gt[i, j] + rng.uniform(-6, 6, 4)
+                    n += 1
+            while n < r - 8:
+                x1, y1 = rng.uniform(0, 80, 2)
+                rois[i, n] = [x1, y1, x1 + rng.uniform(8, 40), y1 + rng.uniform(8, 40)]
+                n += 1
+            valid[i, :n] = True
+        return batch._replace(
+            ext_rois=jnp.asarray(rois), ext_valid=jnp.asarray(valid)
+        )
+
+    def test_fast_rcnn_mode_no_rpn_grads(self, fpn_setup, rng):
+        """rpn.loss_weight=0 + ext rois: loss finite, box head gets
+        gradients, the RPN head gets exactly none (it is out of the graph)."""
+        cfg, model, variables = fpn_setup
+        model = TwoStageDetector(
+            cfg=dataclasses.replace(
+                model.cfg,
+                rpn=dataclasses.replace(model.cfg.rpn, loss_weight=0.0),
+            )
+        )
+        batch = self._with_ext(rng, tiny_batch(rng))
+
+        def loss_fn(params):
+            total, metrics = forward_train(
+                model, {**variables, "params": params},
+                jax.random.PRNGKey(1), batch,
+            )
+            return total, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"]
+        )
+        assert np.isfinite(float(loss))
+        assert float(metrics["RPNLogLoss"]) == 0.0
+        rpn_norm = sum(
+            float(jnp.abs(g).sum())
+            for g in jax.tree_util.tree_leaves(grads["rpn"])
+        )
+        box_norm = sum(
+            float(jnp.abs(g).sum())
+            for g in jax.tree_util.tree_leaves(grads["box_head"])
+        )
+        assert rpn_norm == 0.0
+        assert box_norm > 0.0
+
+    def test_ext_rois_are_what_gets_sampled(self, fpn_setup, rng):
+        """Every sampled roi must come from the ext set (or appended gt)."""
+        cfg, model, variables = fpn_setup
+        model = TwoStageDetector(
+            cfg=dataclasses.replace(
+                model.cfg,
+                rpn=dataclasses.replace(model.cfg.rpn, loss_weight=0.0),
+            )
+        )
+        from mx_rcnn_tpu.detection.graph import sample_rois  # noqa: F401
+        batch = self._with_ext(rng, tiny_batch(rng))
+        # Probe via a tiny wrapper: run the same sampling path by calling
+        # forward_train and checking it used ext rois — indirectly, via
+        # determinism: zeroing ext_valid must change the loss.
+        t1, _ = forward_train(model, variables, jax.random.PRNGKey(1), batch)
+        empty = batch._replace(ext_valid=jnp.zeros_like(batch.ext_valid))
+        t2, _ = forward_train(model, variables, jax.random.PRNGKey(1), empty)
+        assert not np.allclose(float(t1), float(t2))
+
+    def test_rpn_still_trains_when_loss_on(self, fpn_setup, rng):
+        """ext rois with rpn.loss_weight>0: sampling uses ext rois but the
+        RPN keeps its losses (approximate joint mode)."""
+        cfg, model, variables = fpn_setup
+        batch = self._with_ext(rng, tiny_batch(rng))
+        total, metrics = forward_train(
+            model, variables, jax.random.PRNGKey(1), batch
+        )
+        assert np.isfinite(float(total))
+        assert float(metrics["RPNLogLoss"]) > 0.0
+
+    def test_inference_with_ext_proposals(self, fpn_setup, rng):
+        cfg, model, variables = fpn_setup
+        batch = self._with_ext(rng, tiny_batch(rng))
+        dets = forward_inference(model, variables, batch)
+        assert dets.boxes.shape[1] == model.cfg.test.max_detections
+        assert np.isfinite(np.asarray(dets.boxes)).all()
