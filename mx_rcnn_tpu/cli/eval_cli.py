@@ -65,12 +65,9 @@ def _eval_loader(
     with_masks: bool = False,
     proposals_path: Optional[str] = None,
 ):
-    from mx_rcnn_tpu.data import DetectionLoader, build_dataset
+    from mx_rcnn_tpu.data import DetectionLoader, build_dataset, load_proposals
 
-    proposals = None
-    if proposals_path:
-        with open(proposals_path, "rb") as f:
-            proposals = pickle.load(f)
+    proposals = load_proposals(proposals_path) if proposals_path else None
     roidb = build_dataset(cfg.data, train=False).roidb()
     loader = DetectionLoader(
         roidb, cfg.data, batch_size=batch_size, train=False,

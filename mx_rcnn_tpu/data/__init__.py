@@ -21,7 +21,7 @@ from mx_rcnn_tpu.data.datasets import (
     VocDataset,
     build_dataset,
 )
-from mx_rcnn_tpu.data.loader import DetectionLoader, load_image
+from mx_rcnn_tpu.data.loader import DetectionLoader, load_image, load_proposals
 from mx_rcnn_tpu.data.roidb import filter_roidb, merge_roidb
 from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
 
@@ -33,6 +33,7 @@ __all__ = [
     "build_dataset",
     "filter_roidb",
     "load_image",
+    "load_proposals",
     "letterbox",
     "merge_roidb",
     "normalize_image",

@@ -40,6 +40,28 @@ except Exception:  # pragma: no cover
 GT_MASK_SIZE = 112
 
 
+def load_proposals(path: str) -> dict:
+    """Load and validate a proposal pkl (``test.py --proposals`` format:
+    image_id → {"boxes": (n, 4) original-image coords, "scores": (n,)}).
+    Fails fast on schema problems instead of mid-epoch in the loader."""
+    import pickle
+
+    with open(path, "rb") as f:
+        props = pickle.load(f)
+    if not isinstance(props, dict) or not props:
+        raise ValueError(f"{path}: expected a non-empty image_id->dict map")
+    for key, p in props.items():
+        boxes = np.asarray(p.get("boxes", None))
+        scores = np.asarray(p.get("scores", None))
+        if boxes.ndim != 2 or boxes.shape[1] != 4 or scores.shape != boxes.shape[:1]:
+            raise ValueError(
+                f"{path}: image {key!r} needs boxes (n, 4) + scores (n,), "
+                f"got {boxes.shape} / {scores.shape}"
+            )
+        break  # spot-check one entry; full arrays validate lazily per image
+    return props
+
+
 def load_image(rec: RoiRecord) -> np.ndarray:
     """uint8 RGB from disk (float32 for in-memory synthetic images)."""
     if rec.image_array is not None:

@@ -372,6 +372,8 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     gi_axis = 0 if gt_ignore is not None else None
 
     use_ext = batch.ext_rois is not None
+    if use_ext and batch.ext_valid is None:
+        raise ValueError("Batch.ext_rois requires ext_valid (pad mask)")
     if use_ext and cfg.rpn.loss_weight == 0.0:
         # Fast R-CNN mode (reference ``rcnn/tools/train_rcnn.py``): the box
         # head trains on externally supplied proposals and the RPN never
@@ -511,6 +513,8 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detec
     if batch.ext_rois is not None:
         # Fast R-CNN test mode (reference ``test_rcnn --has_rpn false``):
         # score externally supplied proposals; the RPN never runs.
+        if batch.ext_valid is None:
+            raise ValueError("Batch.ext_rois requires ext_valid (pad mask)")
         props = Proposals(
             rois=batch.ext_rois,
             scores=jnp.zeros(batch.ext_valid.shape, jnp.float32),

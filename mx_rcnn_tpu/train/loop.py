@@ -216,12 +216,9 @@ def train(
         _warn_config_drift(cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json")
 
     if loader is None:
-        proposals = None
-        if proposals_path:
-            import pickle
+        from mx_rcnn_tpu.data import load_proposals
 
-            with open(proposals_path, "rb") as f:
-                proposals = pickle.load(f)
+        proposals = load_proposals(proposals_path) if proposals_path else None
         roidb = filter_roidb(build_dataset(cfg.data, train=True).roidb())
         loader = DetectionLoader(
             roidb,

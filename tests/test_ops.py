@@ -487,3 +487,32 @@ def test_sample_rois_crowd_never_bg():
     assert bg.any()  # clean bg still sampled
     ioa = np.asarray(ioa_matrix(s.rois, gt[1:2])).ravel()
     assert (ioa[bg] < 0.5).all()
+
+
+# ---------------- analytic FLOP counter ----------------
+
+
+def test_flops_counter_known_shapes():
+    from mx_rcnn_tpu.utils.flops import count_matmul_flops
+
+    f = lambda x, w: jax.lax.conv_general_dilated(  # noqa: E731
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    x = jnp.zeros((2, 16, 16, 8))
+    w = jnp.zeros((3, 3, 8, 32))
+    assert count_matmul_flops(f, x, w) == 2 * 2 * 16 * 16 * 32 * 8 * 9
+    g = lambda a, b: a @ b  # noqa: E731
+    assert (
+        count_matmul_flops(g, jnp.zeros((64, 128)), jnp.zeros((128, 256)))
+        == 2 * 64 * 128 * 256
+    )
+    # scan multiplies by trip count; grad roughly triples a conv (fwd +
+    # input-transpose + kernel-transpose convs).
+    s = lambda c: jax.lax.scan(  # noqa: E731
+        lambda carry, _: (carry @ jnp.ones((32, 32)), None), c, None, length=5
+    )[0]
+    assert count_matmul_flops(s, jnp.zeros((32, 32))) == 5 * 2 * 32**3
+    h = lambda w_: (f(x, w_) ** 2).sum()  # noqa: E731
+    fwd = count_matmul_flops(lambda w_: f(x, w_), w)
+    both = count_matmul_flops(jax.grad(h), w)
+    assert 2.0 <= both / fwd <= 3.2
