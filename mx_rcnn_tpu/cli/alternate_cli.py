@@ -21,12 +21,18 @@ Proposal dumps are written for artifact parity (the reference's rpn pkl);
 training itself consumes proposals in-graph from the frozen RPN, which keeps
 every phase a single statically-shaped jitted step.
 
-Documented deviation: the reference re-initializes each phase from the
-ImageNet params (its Fast R-CNN phases consume PRECOMPUTED pkl proposals,
-so resetting the trunk is safe).  Here the rcnn phases generate proposals
-in-graph from the frozen phase-1/3 RPN, whose head only matches the trunk
-it was trained on — so ``--pretrained`` seeds phase 1 and later phases
-continue from the previous phase's weights.
+Two schedules are offered:
+
+- default (in-graph): the rcnn phases keep the frozen RPN in the graph and
+  sample from its live proposals.  Deviation from the reference: phases
+  continue from the previous phase's weights (an in-graph frozen RPN only
+  matches the trunk it was trained on), so ``--pretrained`` seeds phase 1
+  only.
+- ``--external-proposals``: the reference-faithful Ren et al. schedule.
+  Each rcnn phase consumes the PRECOMPUTED pkl dumped by the preceding rpn
+  phase (Fast R-CNN mode — the RPN drops out of the graph), which makes
+  per-phase re-initialization safe: rcnn1 restarts from the ImageNet seed
+  exactly as the reference's ``train_rcnn.py`` does.
 """
 
 from __future__ import annotations
@@ -55,10 +61,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     p.add_argument(
         "--pretrained", default=None, metavar="PTH",
-        help="torchvision backbone .pth seeding phase 1. DEVIATION: the "
-        "reference re-seeds every phase from ImageNet; here later phases "
-        "continue from the previous phase because in-graph proposals need "
-        "the frozen RPN to match the trunk (see module docstring)",
+        help="torchvision backbone .pth. Default schedule: seeds phase 1 "
+        "only (see module docstring); with --external-proposals it also "
+        "re-seeds the rcnn1 phase, as the reference does",
+    )
+    p.add_argument(
+        "--external-proposals", action="store_true",
+        help="reference-faithful schedule: rcnn phases train on the pkl "
+        "dumped by the preceding rpn phase (Fast R-CNN mode, RPN out of "
+        "the graph) instead of in-graph frozen-RPN proposals",
     )
     return p.parse_args(argv)
 
@@ -80,11 +91,14 @@ def alternate_train(
     dump_proposals_pkl: bool = True,
     num_phases: int = 4,
     pretrained=None,
+    external_proposals: bool = False,
 ):
     """Run the 6-step schedule; returns the final combined TrainState.
 
     ``num_phases`` < 4 truncates the schedule (tests exercise the phase
     transition without paying for four full compiles).
+    ``external_proposals``: reference-faithful mode — rcnn phases train on
+    the preceding rpn phase's pkl dump (see module docstring).
     """
     import jax
 
@@ -103,24 +117,51 @@ def alternate_train(
         ("rpn2", dict(rpn=True, rcnn=False), shared_conv + ("box_head",), None),
         ("rcnn2", dict(rpn=False, rcnn=True), shared_conv + ("rpn",), "proposals_rpn2.pkl"),
     ]
+    if external_proposals and not dump_proposals_pkl:
+        raise ValueError("--external-proposals requires the proposal dumps")
     state = None
     for name, losses, freeze, dump_before in phases[:num_phases]:
         pcfg = _phase_cfg(cfg, name, losses["rpn"], losses["rcnn"])
+        proposals_path = None
         if dump_before and dump_proposals_pkl and state is not None:
             path = os.path.join(workdir, cfg.name, dump_before)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             dump_proposals(cfg, path, state=state)
-        log.info("=== alternate phase %s (freeze: %s) ===", name, ",".join(freeze))
+            if external_proposals:
+                proposals_path = path
+        # Reference-faithful mode: rcnn1 restarts from the ImageNet seed
+        # and trains on the dumped pkl (Fast R-CNN, RPN out of the graph) —
+        # safe because the proposals are precomputed, exactly like
+        # rcnn/tools/train_rcnn.py.  rcnn2 keeps rpn2's weights (its trunk
+        # is frozen-shared by then, per the 4-step schedule).
+        reseed = external_proposals and name == "rcnn1"
+        if reseed and not pretrained:
+            # Hermetic/synthetic runs may legitimately lack a .pth, but the
+            # reference schedule presumes the ImageNet seed — be loud.
+            log.warning(
+                "--external-proposals without --pretrained: rcnn1 restarts "
+                "from RANDOM init (the reference re-seeds it from ImageNet)"
+            )
+        log.info(
+            "=== alternate phase %s (freeze: %s%s) ===",
+            name, ",".join(freeze),
+            ", external proposals" if proposals_path else "",
+        )
         state = train(
             pcfg,
             mesh=mesh,
             total_steps=phase_steps,
             workdir=workdir,
-            state=jax.device_get(state) if state is not None else None,
+            state=(
+                jax.device_get(state)
+                if state is not None and not reseed
+                else None
+            ),
             extra_freeze=tuple(freeze),
-            # ImageNet seed applies to the fresh phase-1 state; later
-            # phases continue from the previous phase's weights.
-            pretrained=pretrained if state is None else None,
+            # ImageNet seed applies to fresh states only: phase 1, and the
+            # re-seeded rcnn1 of the reference-faithful schedule.
+            pretrained=pretrained if (state is None or reseed) else None,
+            proposals_path=proposals_path,
         )
     # combine_model parity: nothing to merge — one pytree holds RPN + RCNN.
     # Save the combined result under the BASE config name so eval/demo find
@@ -154,6 +195,7 @@ def main(argv=None):
         workdir=cfg.workdir,
         dump_proposals_pkl=not args.no_proposal_dump,
         pretrained=args.pretrained,
+        external_proposals=args.external_proposals,
     )
     from mx_rcnn_tpu.cli.eval_cli import run_eval
 

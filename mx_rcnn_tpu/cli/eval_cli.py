@@ -180,11 +180,18 @@ def dump_proposals(
     ckpt_dir: Optional[str] = None,
     step: Optional[int] = None,
     train_split: bool = True,
+    use_train_counts: Optional[bool] = None,
 ) -> dict:
     """Run the RPN over a split and dump per-image proposal boxes+scores.
 
     The alternate-training bridge: phase N's RPN writes the proposal roidb
     consumed by phase N+1's Fast R-CNN training (SURVEY.md §4.2 steps 2/5).
+
+    ``use_train_counts`` (default: follows ``train_split``): generate the
+    TRAIN-config proposal counts (pre/post-NMS top-n, e.g. 2000) instead of
+    the test counts (e.g. 300) — proposals destined for Fast R-CNN
+    *training* must match the reference's TRAIN.RPN_POST_NMS_TOP_N pool,
+    not the test pool.
     """
     import dataclasses
 
@@ -198,6 +205,22 @@ def dump_proposals(
     if state is None:
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
+    if use_train_counts is None:
+        use_train_counts = train_split
+    if use_train_counts:
+        # forward_proposals runs the test-config proposal path; give it the
+        # train counts so the dumped pool matches what training samples.
+        cfg = dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(
+                cfg.model,
+                rpn=dataclasses.replace(
+                    cfg.model.rpn,
+                    test_pre_nms_top_n=cfg.model.rpn.train_pre_nms_top_n,
+                    test_post_nms_top_n=cfg.model.rpn.train_post_nms_top_n,
+                ),
+            ),
+        )
     model = TwoStageDetector(cfg=cfg.model)
     # Device-resident params: see run_eval — numpy params re-upload per call.
     variables = jax.device_put(eval_variables(state))

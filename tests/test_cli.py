@@ -207,3 +207,24 @@ class TestFastRcnnMode:
         )
         metrics = run_eval(fast_cfg, state=fast_state, proposals_path=val_pkl)
         assert any("AP" in k for k in metrics)
+
+
+@pytest.mark.slow
+class TestAlternateExternalProposals:
+    def test_reference_faithful_schedule(self, tmp_path):
+        """--external-proposals: rcnn1 restarts fresh and trains on the
+        rpn1 pkl with the RPN out of the graph."""
+        import jax
+
+        from mx_rcnn_tpu.cli.alternate_cli import alternate_train
+
+        cfg = _tiny(tmp_path, steps=2)
+        state = alternate_train(
+            cfg, phase_steps=2, workdir=str(tmp_path),
+            dump_proposals_pkl=True, num_phases=2, external_proposals=True,
+        )
+        assert int(state.step) == 2
+        pkl = os.path.join(str(tmp_path), cfg.name, "proposals_rpn1.pkl")
+        assert os.path.exists(pkl)
+        leaves = jax.tree_util.tree_leaves(state.params)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
