@@ -158,6 +158,10 @@ class DataConfig:
     pixel_mean: tuple[float, float, float] = (123.675, 116.28, 103.53)
     pixel_std: tuple[float, float, float] = (58.395, 57.12, 57.375)
     aspect_grouping: bool = True
+    # Parsed-roidb pickle cache directory (reference: imdb.gt_roidb caches
+    # under data/cache/<name>_gt_roidb.pkl).  "" disables; entries are
+    # invalidated by the annotation source's mtime.
+    cache_dir: str = ""
 
 
 @dataclass(frozen=True)

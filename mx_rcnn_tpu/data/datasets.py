@@ -250,12 +250,109 @@ class VocDataset:
         return [self._parse(i) for i in self.image_index]
 
 
+class _CachedRoidb:
+    """Lazy parsed-roidb pickle cache (reference:
+    ``rcnn/dataset/imdb.py::gt_roidb`` caches
+    ``data/cache/<name>_gt_roidb.pkl``).  On a cache hit the underlying
+    dataset is never constructed — the win is skipping the multi-hundred-MB
+    COCO annotation json parse, which happens in the constructor.  Entries
+    are keyed by the annotation source's mtime, so edited annotations
+    re-parse.  Attribute access (``classes`` etc.) constructs on demand."""
+
+    def __init__(self, factory, name: str, cache_dir: str, split: str,
+                 root: str, fingerprint) -> None:
+        self._factory = factory
+        self._name = name
+        self._cache_dir = cache_dir
+        self._split = split
+        self._root = root
+        self._fingerprint = fingerprint  # () -> Optional[str]
+        self._ds = None
+
+    def _dataset(self):
+        if self._ds is None:
+            self._ds = self._factory()
+        return self._ds
+
+    def __getattr__(self, name):
+        return getattr(self._dataset(), name)
+
+    def roidb(self) -> list[RoiRecord]:
+        import hashlib
+        import pickle
+
+        fp = self._fingerprint()
+        if fp is None:
+            return self._dataset().roidb()
+        # Key carries the dataset ROOT too: a relocated/second dataset copy
+        # must not hit a cache whose RoiRecord.image_path points elsewhere.
+        key = hashlib.sha1(
+            f"{os.path.abspath(self._root)}|{fp}".encode()
+        ).hexdigest()[:16]
+        path = os.path.join(
+            self._cache_dir,
+            f"{self._name}_{self._split}_{key}_gt_roidb.pkl",
+        )
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        roidb = self._dataset().roidb()
+        os.makedirs(self._cache_dir, exist_ok=True)
+        # Per-process tmp: concurrent writers (multi-host startup over a
+        # shared cache_dir) must not interleave into one file.
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(roidb, f)
+        os.replace(tmp, path)
+        return roidb
+
+
+def _mtime_fingerprint(path: str):
+    """mtime of one file, or None if unreadable (→ cache bypass)."""
+    try:
+        return str(int(os.stat(path).st_mtime))
+    except OSError:
+        return None
+
+
+def _voc_fingerprint(devkit: str, index_file: str):
+    """ImageSets txt mtime + the NEWEST Annotations xml mtime: editing any
+    annotation invalidates (a directory's own mtime only changes on
+    add/remove, not edits)."""
+    base = _mtime_fingerprint(index_file)
+    if base is None:
+        return None
+    newest = 0
+    try:
+        with os.scandir(os.path.join(devkit, "Annotations")) as it:
+            for e in it:
+                if e.name.endswith(".xml"):
+                    newest = max(newest, int(e.stat().st_mtime))
+    except OSError:
+        return None
+    return f"{base}|{newest}"
+
+
 def build_dataset(cfg: DataConfig, split: Optional[str] = None, train: bool = True):
     split = split or (cfg.train_split if train else cfg.val_split)
     if cfg.dataset == "synthetic":
         return SyntheticDataset(image_hw=cfg.image_size)
     if cfg.dataset == "coco":
-        return CocoDataset(cfg.root, split)
-    if cfg.dataset == "voc":
-        return VocDataset(cfg.root, split)
-    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+        factory = lambda: CocoDataset(cfg.root, split)  # noqa: E731
+        name = "coco"
+        ann = os.path.join(cfg.root, "annotations", f"instances_{split}.json")
+        fingerprint = lambda: _mtime_fingerprint(ann)  # noqa: E731
+    elif cfg.dataset == "voc":
+        factory = lambda: VocDataset(cfg.root, split)  # noqa: E731
+        name = "voc"
+        year, imageset = split.split("_")
+        devkit = os.path.join(cfg.root, f"VOC{year}")
+        index = os.path.join(devkit, "ImageSets", "Main", f"{imageset}.txt")
+        fingerprint = lambda: _voc_fingerprint(devkit, index)  # noqa: E731
+    else:
+        raise ValueError(f"unknown dataset {cfg.dataset!r}")
+    if cfg.cache_dir:
+        return _CachedRoidb(
+            factory, name, cfg.cache_dir, split, cfg.root, fingerprint
+        )
+    return factory()

@@ -325,3 +325,92 @@ class TestExternalProposals:
     def test_missing_proposals_rejected(self, rng):
         with pytest.raises(ValueError, match="no proposals"):
             self._loader(rng, {"other": {}})
+
+
+class TestRoidbCache:
+    def _cfg(self, tmp_path, root):
+        import dataclasses
+
+        return dataclasses.replace(
+            _loader_cfg(dataset="coco"),
+            root=str(root), val_split="val",
+            cache_dir=str(tmp_path / "cache"),
+        )
+
+    def test_hit_skips_parse_and_matches(self, tmp_path):
+        import mx_rcnn_tpu.data.datasets as dsmod
+        from mx_rcnn_tpu.data.datasets import build_dataset
+
+        root = TestCoco()._make_coco(tmp_path)
+        cfg = self._cfg(tmp_path, root)
+        first = build_dataset(cfg, train=False).roidb()
+        cache_files = list((tmp_path / "cache").glob("*_gt_roidb.pkl"))
+        assert len(cache_files) == 1
+
+        # Second build: the dataset constructor must never run.
+        calls = []
+        orig = dsmod.CocoDataset.__init__
+
+        def spy(self, *a, **k):
+            calls.append(1)
+            return orig(self, *a, **k)
+
+        dsmod.CocoDataset.__init__ = spy
+        try:
+            second = build_dataset(cfg, train=False).roidb()
+        finally:
+            dsmod.CocoDataset.__init__ = orig
+        assert not calls
+        assert len(second) == len(first)
+        np.testing.assert_allclose(second[0].boxes, first[0].boxes)
+        np.testing.assert_array_equal(second[0].ignore_flags, first[0].ignore_flags)
+
+    def test_mtime_invalidation(self, tmp_path):
+        import os
+        import time
+
+        from mx_rcnn_tpu.data.datasets import build_dataset
+
+        root = TestCoco()._make_coco(tmp_path)
+        cfg = self._cfg(tmp_path, root)
+        build_dataset(cfg, train=False).roidb()
+        src = root / "annotations" / "instances_val.json"
+        os.utime(src, (time.time() + 10, time.time() + 10))
+        build_dataset(cfg, train=False).roidb()
+        assert len(list((tmp_path / "cache").glob("*_gt_roidb.pkl"))) == 2
+
+    def test_voc_annotation_edit_invalidates(self, tmp_path):
+        import dataclasses
+        import os
+        import time
+
+        from mx_rcnn_tpu.data.datasets import build_dataset
+
+        root = TestVoc()._make_devkit(tmp_path)
+        cfg = dataclasses.replace(
+            _loader_cfg(dataset="voc"),
+            root=str(root), val_split="2007_trainval",
+            cache_dir=str(tmp_path / "cache"),
+        )
+        build_dataset(cfg, train=False).roidb()
+        xml = root / "VOC2007" / "Annotations" / "000001.xml"
+        os.utime(xml, (time.time() + 10, time.time() + 10))
+        build_dataset(cfg, train=False).roidb()
+        assert len(list((tmp_path / "cache").glob("voc_*_gt_roidb.pkl"))) == 2
+
+    def test_relocated_root_misses(self, tmp_path):
+        import dataclasses
+        import shutil
+
+        from mx_rcnn_tpu.data.datasets import build_dataset
+
+        (tmp_path / "a").mkdir()
+        root = TestCoco()._make_coco(tmp_path / "a")
+        cfg = self._cfg(tmp_path, root)
+        build_dataset(cfg, train=False).roidb()
+        shutil.copytree(
+            str(tmp_path / "a"), str(tmp_path / "b"), copy_function=shutil.copy2
+        )
+        cfg_b = dataclasses.replace(cfg, root=str(tmp_path / "b"))
+        build_dataset(cfg_b, train=False).roidb()
+        assert len(list((tmp_path / "cache").glob("coco_*_gt_roidb.pkl"))) == 2

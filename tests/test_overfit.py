@@ -41,11 +41,15 @@ def test_overfit_synthetic():
     assert jax.default_backend() == "cpu", "golden gate is CPU-only"
     state = train(cfg, mesh=None)
     metrics = run_eval(cfg, state=state)
+    print("overfit metrics:", {k: round(v, 4) for k, v in metrics.items()})
     # Golden-number regression gate (VERDICT r1 #7): the seeded CPU run is
     # deterministic, so drift beyond tolerance means a behavior change in
     # the train/eval stack, not noise.  If a deliberate change moves the
     # number, re-record it here AND in BASELINE.md's measured table.
-    golden_ap, golden_ap50 = 0.460, 0.766  # recorded 2026-07-30, seed 0
+    # History: r1 recorded AP 0.460 / AP50 0.766; the r2 stack reaches
+    # AP 0.7789 / AP50 0.9661 on the identical seeded recipe (re-recorded
+    # 2026-07-31, reproduced exactly across two runs).
+    golden_ap, golden_ap50 = 0.779, 0.966
     assert abs(metrics["AP"] - golden_ap) < 0.03, metrics
     assert abs(metrics["AP50"] - golden_ap50) < 0.05, metrics
 
