@@ -121,7 +121,6 @@ def run_eval(
     if state is None:
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
-    model = TwoStageDetector(cfg=cfg.model)
     # All visible chips evaluate in data parallel, test.per_device_batch
     # images per chip per step (the reference's test path is strictly
     # single-device, one image at a time).  Gated to single-process runs:
@@ -132,6 +131,9 @@ def run_eval(
         if jax.device_count() > 1 and jax.process_count() == 1
         else None
     )
+    from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
+
+    model = TwoStageDetector(cfg=mesh_safe_model_cfg(cfg.model, mesh))
     eval_step = make_eval_step(model, mesh=mesh)
     # Pin the inference params on device ONCE.  Feeding the numpy pytree
     # into the jitted step would re-upload every parameter on every call —

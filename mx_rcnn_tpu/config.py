@@ -99,10 +99,12 @@ class RCNNConfig:
     # Class-agnostic box regression (False = per-class, reference default).
     class_agnostic: bool = False
     loss_weight: float = 1.0
-    # ROIAlign backend: "xla" (gather; default — in-graph it matches the
-    # kernel within noise once XLA fuses the step) or "pallas" (windowed
-    # DMA kernel, TPU only; see ops/pallas/roi_align.py measurements).
-    roi_align_impl: str = "xla"
+    # ROIAlign backend: "pallas" (default — one batch-folded windowed-DMA
+    # kernel launch per step; measured 83.1 -> 77.6 ms/step on the full
+    # R50-FPN train step once the whole batch rides one grid) or "xla"
+    # (flattened-pyramid gather — the oracle, the backward, and the
+    # automatic fallback off-TPU or on unsupported layouts).
+    roi_align_impl: str = "pallas"
 
 
 @dataclass(frozen=True)

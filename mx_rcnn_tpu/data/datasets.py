@@ -250,6 +250,12 @@ class VocDataset:
         return [self._parse(i) for i in self.image_index]
 
 
+# Bump when roidb PARSING changes (crowd ordering, box conventions, new
+# RoiRecord fields): the fingerprint only sees the annotation files, so a
+# parser fix must invalidate existing caches itself.
+_CACHE_VERSION = 2
+
+
 class _CachedRoidb:
     """Lazy parsed-roidb pickle cache (reference:
     ``rcnn/dataset/imdb.py::gt_roidb`` caches
@@ -287,20 +293,28 @@ class _CachedRoidb:
         # Key carries the dataset ROOT too: a relocated/second dataset copy
         # must not hit a cache whose RoiRecord.image_path points elsewhere.
         key = hashlib.sha1(
-            f"{os.path.abspath(self._root)}|{fp}".encode()
+            f"v{_CACHE_VERSION}|{os.path.abspath(self._root)}|{fp}".encode()
         ).hexdigest()[:16]
         path = os.path.join(
             self._cache_dir,
             f"{self._name}_{self._split}_{key}_gt_roidb.pkl",
         )
         if os.path.exists(path):
-            with open(path, "rb") as f:
-                return pickle.load(f)
+            try:
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            except Exception:
+                # Corrupt or stale-format entry: self-heal by re-parsing
+                # (the rewrite below replaces the poisoned file).
+                pass
         roidb = self._dataset().roidb()
         os.makedirs(self._cache_dir, exist_ok=True)
-        # Per-process tmp: concurrent writers (multi-host startup over a
-        # shared cache_dir) must not interleave into one file.
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # Unique tmp per writer: concurrent multi-host startups over a
+        # shared cache_dir must not interleave into one file (pids collide
+        # across containers, so a uuid, not getpid).
+        import uuid
+
+        tmp = f"{path}.{uuid.uuid4().hex}.tmp"
         with open(tmp, "wb") as f:
             pickle.dump(roidb, f)
         os.replace(tmp, path)

@@ -212,11 +212,12 @@ def _slice_levels(levels, anchors, score_row, delta_row):
 def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
     """ROIAlign over the batch. rois: (B, R, 4) -> (B, R, S, S, C).
 
-    ``cfg.rcnn.roi_align_impl`` picks the backend: "xla" gathers (default —
-    measured equal to the kernel inside the fused train step on a v5e:
-    3.59 vs 3.69 ms/step) or "pallas" (one windowed HBM-DMA pass per roi;
-    2x faster standalone, TPU only).  The XLA implementation supplies the
-    backward pass either way.
+    ``cfg.rcnn.roi_align_impl`` picks the backend: "pallas" (default — ONE
+    batch-folded kernel launch per step; measured 83.1 -> 77.6 ms on the
+    full R50-FPN train step, 219.5 -> 118.8 ms on the batch-8 eval step)
+    or "xla" (flattened-pyramid gather — the oracle and the automatic
+    fallback off-TPU, on single-level C4 pyramids, and on unsupported
+    layouts).  The XLA implementation supplies the backward either way.
     """
     if cfg.rcnn.roi_align_impl not in ("xla", "pallas"):
         raise ValueError(
@@ -234,23 +235,23 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
     if want_pallas and not can_pallas:
         import logging
 
-        logging.getLogger("mx_rcnn_tpu").warning(
-            "roi_align_impl='pallas' requested but unavailable "
+        # Expected fallbacks (off-TPU; single-level C4 pyramid) are quiet —
+        # pallas is the config default.  A genuinely unsupported LAYOUT on
+        # a multi-level TPU pyramid is worth a warning.
+        lg = logging.getLogger("mx_rcnn_tpu")
+        unexpected = jax.default_backend() == "tpu" and len(levels) > 1
+        (lg.warning if unexpected else lg.debug)(
+            "roi_align_impl='pallas' unavailable "
             "(levels=%d, backend=%s) — using the XLA path",
             len(levels), jax.default_backend(),
         )
     if len(levels) > 1:
         if want_pallas and can_pallas:
-            per_image = [
-                multilevel_roi_align_fast(
-                    {l: f[b] for l, f in roi_levels.items()},
-                    rois[b],
-                    pooled_size,
-                    cfg.rcnn.sampling_ratio,
-                )
-                for b in range(rois.shape[0])
-            ]
-            return jnp.stack(per_image)
+            # Whole batch in ONE kernel launch: the batch folds into the
+            # pallas grid (B*R roi steps), no per-image python unroll.
+            return multilevel_roi_align_fast(
+                roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio
+            )
         return jax.vmap(
             lambda fs, r: multilevel_roi_align(
                 fs, r, output_size=pooled_size, sampling_ratio=cfg.rcnn.sampling_ratio

@@ -6,10 +6,15 @@ upgraded to ROIAlign.  The XLA fallback (:mod:`mx_rcnn_tpu.ops.roi_align`)
 pools every roi from every pyramid level and masks (4x redundant compute,
 gather-bound); this kernel does one pass:
 
-- grid = one step per roi;
-- the roi's assigned level (scalar-prefetched) selects which HBM feature
-  map a ``(T, T, C)`` window is DMA'd from — only the window travels over
-  HBM, never a whole pyramid level per roi;
+- grid = one step per roi, across the WHOLE batch (B*R steps — batching
+  is a column of the per-roi parameter block, not a loop of kernel calls);
+- each roi's parameter row (geometry + assigned level + window origin +
+  batch index) streams in as a tiny per-step SMEM block — NOT a
+  scalar-prefetch table, which costs ~512 B of smem per row and cannot
+  hold a batched-eval grid (see _kernel);
+- the roi's assigned level selects which HBM feature map a ``(T, T, C)``
+  window is DMA'd from — only the window travels over HBM, never a whole
+  pyramid level per roi;
 - bilinear interpolation is expressed as two small matmuls with sparse
   interpolation matrices ``pooled = mean_pool(Wy @ window @ Wx^T)`` — the
   MXU-friendly formulation of "gather 4 corners per sample" (each Wy/Wx row
@@ -70,8 +75,12 @@ def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
 
 
 def _kernel(
-    meta_ref,      # scalar prefetch: (R, 3) int32 [level_idx, oy, ox]
-    roi_ref,       # scalar prefetch: (R, 8) f32 [x1, y1, bin_w, bin_h, H, W, 0, 0]
+    roi_ref,       # SMEM block (1, 1, 10) f32, one roi per grid step:
+                   # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch]
+                   # Streamed per step, NOT scalar-prefetched: a prefetch
+                   # table costs ~512 B of smem PER ROW, so an N = B*R
+                   # batched-eval grid (8000 rois) would need 4 MB of the
+                   # 1 MB smem.  The indices ride as f32 (exact < 2^24).
     *rest,
     num_levels: int,
     t: int,
@@ -83,16 +92,18 @@ def _kernel(
     win = rest[num_levels + 1]
     sem = rest[num_levels + 2]
 
-    r = pl.program_id(0)
-    level = meta_ref[r, 0]
-    oy = meta_ref[r, 1]
-    ox = pl.multiple_of(meta_ref[r, 2], 8)
+    level = roi_ref[0, 0, 6].astype(jnp.int32)
+    oy = roi_ref[0, 0, 7].astype(jnp.int32)
+    ox = pl.multiple_of(roi_ref[0, 0, 8].astype(jnp.int32), 8)
+    bi = roi_ref[0, 0, 9].astype(jnp.int32)
 
-    # Window DMA from the assigned level.  Maps smaller than T copy their
-    # full extent into the top-left corner of the (zeroed) window.
+    # Window DMA from the assigned level of the roi's image.  The whole
+    # batch rides ONE grid (N = B*R steps) — batching is a meta column, not
+    # a python loop of pallas_calls.  Maps smaller than T copy their full
+    # extent into the top-left corner of the (zeroed) window.
     for i, f in enumerate(feat_refs):
-        th = min(t, f.shape[0])
-        tw = min(t, f.shape[1])
+        th = min(t, f.shape[1])
+        tw = min(t, f.shape[2])
         if th < t or tw < t:
             @pl.when(level == i)
             def _():
@@ -101,19 +112,19 @@ def _kernel(
         @pl.when(level == i)
         def _(f=f, th=th, tw=tw):
             dma = pltpu.make_async_copy(
-                f.at[pl.ds(oy, th), pl.ds(ox, tw), :],
+                f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
                 win.at[pl.ds(0, th), pl.ds(0, tw), :],
                 sem,
             )
             dma.start()
             dma.wait()
 
-    x1 = roi_ref[r, 0]
-    y1 = roi_ref[r, 1]
-    bin_w = roi_ref[r, 2]
-    bin_h = roi_ref[r, 3]
-    hl = roi_ref[r, 4]
-    wl = roi_ref[r, 5]
+    x1 = roi_ref[0, 0, 0]
+    y1 = roi_ref[0, 0, 1]
+    bin_w = roi_ref[0, 0, 2]
+    bin_h = roi_ref[0, 0, 3]
+    hl = roi_ref[0, 0, 4]
+    wl = roi_ref[0, 0, 5]
 
     s, sr = output_size, sampling_ratio
     wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
@@ -153,33 +164,41 @@ def multilevel_roi_align_pallas(
     window: int = 48,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Drop-in replacement for :func:`multilevel_roi_align` (same contract:
-    pyramid {level: (H_l, W_l, C)}, rois (R, 4) image coords -> (R, S, S, C)).
+    """Drop-in replacement for :func:`multilevel_roi_align`.
+
+    Accepts the per-image contract — pyramid {level: (H_l, W_l, C)},
+    rois (R, 4) → (R, S, S, C) — or the batched one: {level: (B, H_l, W_l,
+    C)}, rois (B, R, 4) → (B, R, S, S, C).  The batch folds into the
+    kernel grid (one step per roi across ALL images, B*R total), so a
+    batched call is ONE pallas_call, not B.
     """
     levels = sorted(feature_pyramid.keys())
+    batched = rois.ndim == 3
+    if not batched:
+        feature_pyramid = {l: f[None] for l, f in feature_pyramid.items()}
+        rois = rois[None]
     feats = [feature_pyramid[l] for l in levels]
-    n_rois = rois.shape[0]
+    b, r_per = rois.shape[:2]
+    flat = rois.reshape(-1, 4)
+    n = flat.shape[0]
     c = feats[0].shape[-1]
     t = window
 
     assignment = fpn_level_assignment(
-        rois, min_level=levels[0], max_level=levels[-1],
+        flat, min_level=levels[0], max_level=levels[-1],
         max_extent_cells=window - 10,
     )
     level_idx = assignment - levels[0]                         # 0-based
 
     # Per-roi geometry in its level's cell units (gather per-level consts).
     scale = jnp.asarray([1.0 / (1 << l) for l in levels], jnp.float32)[level_idx]
-    hs = jnp.asarray([f.shape[0] for f in feats], jnp.float32)[level_idx]
-    ws = jnp.asarray([f.shape[1] for f in feats], jnp.float32)[level_idx]
-    x1 = rois[:, 0] * scale
-    y1 = rois[:, 1] * scale
-    rw = jnp.maximum(rois[:, 2] * scale - x1, 1.0)
-    rh = jnp.maximum(rois[:, 3] * scale - y1, 1.0)
-    roi_params = jnp.stack(
-        [x1, y1, rw / output_size, rh / output_size, hs, ws,
-         jnp.zeros_like(x1), jnp.zeros_like(x1)], axis=1,
-    ).astype(jnp.float32)                                      # (R, 8)
+    hs = jnp.asarray([f.shape[1] for f in feats], jnp.float32)[level_idx]
+    ws = jnp.asarray([f.shape[2] for f in feats], jnp.float32)[level_idx]
+    x1 = flat[:, 0] * scale
+    y1 = flat[:, 1] * scale
+    rw = jnp.maximum(flat[:, 2] * scale - x1, 1.0)
+    rh = jnp.maximum(flat[:, 3] * scale - y1, 1.0)
+    roi_geom = [x1, y1, rw / output_size, rh / output_size, hs, ws]
 
     # Window origin: one cell of bilinear margin, clamped into the map.
     # ox additionally floors to a multiple of 8 — Mosaic requires provable
@@ -188,7 +207,17 @@ def multilevel_roi_align_pallas(
     oy = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - t, 0)).astype(jnp.int32)
     ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws - t, 0)).astype(jnp.int32)
     ox = (ox // 8) * 8
-    meta = jnp.stack([level_idx, oy, ox], axis=1)              # (R, 3) int32
+    bidx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), r_per)
+    # Indices ride the same f32 table as the geometry (exact for values
+    # < 2^24; feature maps are nowhere near that) — see _kernel docstring.
+    roi_params = jnp.stack(
+        roi_geom
+        + [level_idx.astype(jnp.float32), oy.astype(jnp.float32),
+           ox.astype(jnp.float32), bidx.astype(jnp.float32)],
+        axis=1,
+    ).astype(jnp.float32)[:, None, :]                          # (N, 1, 10)
+    # 3-D so the SMEM block's last two dims equal the array's (Mosaic's
+    # block-shape divisibility rule exempts full-extent dims).
 
     kernel = functools.partial(
         _kernel,
@@ -197,28 +226,30 @@ def multilevel_roi_align_pallas(
         output_size=output_size,
         sampling_ratio=sampling_ratio,
     )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(n_rois,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
+    out = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 10), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+            )
+        ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
         out_specs=pl.BlockSpec(
             (1, output_size, output_size, c),
-            lambda r, meta, roip: (r, 0, 0, 0),
+            lambda r: (r, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
             pltpu.VMEM((t, t, c), feats[0].dtype),
             pltpu.SemaphoreType.DMA(()),
         ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (n_rois, output_size, output_size, c), feats[0].dtype
+            (n, output_size, output_size, c), feats[0].dtype
         ),
         interpret=interpret,
-    )(meta, roi_params, *feats)
+    )(roi_params, *feats)
+    out = out.reshape(b, r_per, output_size, output_size, c)
+    return out if batched else out[0]
 
 
 def pallas_supported(feature_pyramid: dict, window: int = 48) -> bool:
@@ -269,13 +300,18 @@ def _fast_bwd(output_size, sampling_ratio, window, res, g):
     from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
 
     feature_pyramid, rois = res
-    _, vjp = jax.vjp(
-        lambda p: multilevel_roi_align(
-            p, rois, output_size=output_size, sampling_ratio=sampling_ratio,
+
+    def ref(p, rr):
+        return multilevel_roi_align(
+            p, rr, output_size=output_size, sampling_ratio=sampling_ratio,
             max_extent_cells=window - 10,
-        ),
-        feature_pyramid,
-    )
+        )
+
+    if rois.ndim == 3:  # batched: vmap the XLA reference over images
+        fn = lambda p: jax.vmap(ref)(p, rois)  # noqa: E731
+    else:
+        fn = lambda p: ref(p, rois)  # noqa: E731
+    _, vjp = jax.vjp(fn, feature_pyramid)
     (grad_pyramid,) = vjp(g)
     return grad_pyramid, jnp.zeros_like(rois)
 

@@ -121,6 +121,30 @@ def make_train_step(
     )
 
 
+def mesh_safe_model_cfg(model_cfg, mesh):
+    """Model config adjusted for a >1-device mesh.
+
+    The Pallas ROIAlign has no GSPMD partitioning rule: under a sharded
+    jit XLA would replicate the kernel call (gathering every image's
+    pyramid to every chip) instead of running it per-shard.  Until a
+    shard_map wrapping is validated on real multi-chip hardware, sharded
+    steps keep the XLA form (identical numerics — it is the kernel's
+    oracle).  Single-device meshes and mesh=None pass through unchanged.
+    """
+    if (
+        mesh is not None
+        and mesh.size > 1
+        and model_cfg.rcnn.roi_align_impl == "pallas"
+    ):
+        import dataclasses
+
+        return dataclasses.replace(
+            model_cfg,
+            rcnn=dataclasses.replace(model_cfg.rcnn, roi_align_impl="xla"),
+        )
+    return model_cfg
+
+
 def make_eval_step(model: TwoStageDetector, mesh: Optional[Mesh] = None):
     """Build ``eval_step(variables, batch) -> Detections`` (jitted)."""
 

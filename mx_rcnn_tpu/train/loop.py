@@ -57,7 +57,12 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     ``pretrained``: path to a torchvision-style ResNet ``.pth`` whose
     weights+BN stats seed the backbone (reference: ``load_param`` on the
     ImageNet ``.params`` file before training)."""
-    model = TwoStageDetector(cfg=cfg.model)
+    from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
+
+    model_cfg = mesh_safe_model_cfg(cfg.model, mesh)
+    if model_cfg is not cfg.model:
+        log.info("multi-chip mesh: using the XLA ROIAlign (pallas is 1-chip)")
+    model = TwoStageDetector(cfg=model_cfg)
     rng = jax.random.PRNGKey(cfg.train.seed)
     n_dev = mesh.size if mesh is not None else 1
     sp = cfg.train.spatial_partition

@@ -79,6 +79,56 @@ class TestPallasRoiAlign:
             np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
         )
 
+
+    def test_batched_matches_per_image(self, rng):
+        """(B, R, 4) rois + (B, H, W, C) pyramid in ONE kernel launch equals
+        the per-image calls it replaced."""
+        b = 3
+        pyrs = [_pyramid(rng) for _ in range(b)]
+        roiss = [_random_rois(rng, 16) for _ in range(b)]
+        batched_pyr = {
+            l: jnp.stack([p[l] for p in pyrs]) for l in pyrs[0]
+        }
+        batched_rois = jnp.stack(roiss)
+        out = multilevel_roi_align_pallas(
+            batched_pyr, batched_rois, output_size=7, sampling_ratio=2,
+            interpret=True,
+        )
+        assert out.shape[:2] == (b, 16)
+        for i in range(b):
+            ref = multilevel_roi_align_pallas(
+                pyrs[i], roiss[i], output_size=7, sampling_ratio=2,
+                interpret=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[i]), np.asarray(ref), atol=1e-5
+            )
+
+    def test_batched_custom_vjp_matches_xla_grad(self, rng):
+        b = 2
+        pyr = {l: jnp.stack([_pyramid(rng)[l] for _ in range(b)])
+               for l in (2, 3, 4, 5)}
+        rois = jnp.stack([_random_rois(rng, 8) for _ in range(b)])
+
+        # Gradient of the XLA reference, vmapped, vs the custom-vjp backward.
+        ref_fn = lambda p: jax.vmap(
+            lambda pp, rr: multilevel_roi_align(
+                pp, rr, output_size=7, sampling_ratio=2, max_extent_cells=38
+            )
+        )(p, rois).sum()
+        g_ref = jax.grad(ref_fn)(pyr)
+        from mx_rcnn_tpu.ops.pallas import roi_align as pra
+
+        # Call the registered backward directly (the forward needs a TPU).
+        out_shape = (b, 8, 7, 7, pyr[2].shape[-1])
+        g = jnp.ones(out_shape, jnp.float32)
+        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, (pyr, rois), g)
+        for l in pyr:
+            np.testing.assert_allclose(
+                np.asarray(grad_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
+            )
+        assert grad_rois.shape == rois.shape
+
     def test_custom_vjp_matches_xla_grad(self, rng):
         """multilevel_roi_align_fast: pallas forward, XLA backward — its
         feature gradients must equal differentiating the XLA path."""
