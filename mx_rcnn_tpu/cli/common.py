@@ -53,3 +53,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
         cfg = dataclasses.replace(cfg, workdir=args.workdir)
     return cfg
+
+
+def default_use_07_metric(cfg: Config) -> bool:
+    """The VOC metric auto-default shared by eval and reeval: the 11-point
+    AP for VOC2007 test splits (the reference evaluates VOC07 with
+    use_07_metric=True), the area metric everywhere else."""
+    return cfg.data.dataset == "voc" and cfg.data.val_split.startswith("2007")

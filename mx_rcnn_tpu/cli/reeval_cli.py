@@ -19,7 +19,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
     add_config_args(p)
     p.add_argument("detections", help="dump file from eval_cli --dump")
-    p.add_argument("--use-07-metric", action="store_true")
+    p.add_argument(
+        "--use-07-metric",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="VOC 11-point AP (default: auto — on for VOC2007 test splits)",
+    )
     return p.parse_args(argv)
 
 
@@ -33,6 +38,11 @@ def main(argv=None) -> dict:
 
     per_image = load_detections(args.detections)
     roidb = build_dataset(cfg.data, train=False).roidb()
+    from mx_rcnn_tpu.cli.common import default_use_07_metric
+
+    use_07 = args.use_07_metric
+    if use_07 is None:
+        use_07 = default_use_07_metric(cfg)
     style = "voc" if cfg.data.dataset == "voc" else "coco"
     class_names = None
     if cfg.data.dataset == "voc":
@@ -41,7 +51,7 @@ def main(argv=None) -> dict:
         class_names = ("__background__",) + VOC_CLASSES
     metrics = evaluate_detections(
         per_image, roidb, cfg.model.num_classes, style, class_names,
-        use_07_metric=args.use_07_metric,
+        use_07_metric=use_07,
     )
     for k, v in sorted(metrics.items()):
         log.info("%s = %.4f", k, v)

@@ -147,10 +147,17 @@ class DataConfig:
     root: str = "data"
     train_split: str = "train2017"
     val_split: str = "val2017"
-    # Static padded image size (H, W).  The reference resizes short side to
-    # SCALES[0]=600 capped at MAX_SIZE=1000 and re-binds executors per shape;
-    # we letterbox into one static canvas — the TPU-native equivalent.
-    image_size: tuple[int, int] = (1024, 1024)
+    # Static LANDSCAPE canvas (H, W), H <= W; portrait images letterbox
+    # into its transpose (data/transforms.py::oriented_canvas — batches
+    # are single-orientation under aspect_grouping, so each orientation is
+    # one compiled program).  The reference resizes short side to
+    # SCALES[0] capped at MAX_SIZE and re-binds executors per shape; two
+    # static canvases are the TPU-native equivalent that preserves the
+    # full short/max rule: 800x1344 fits every 800-short/1333-max resize
+    # (1344 = 42*32 for FPN stride divisibility) at ~1.03x the pixels of
+    # the old square 1024^2 canvas, which silently clamped most images
+    # below the Detectron recipe resolution.
+    image_size: tuple[int, int] = (800, 1344)
     short_side: int = 800
     max_side: int = 1333
     max_gt_boxes: int = 100
@@ -160,6 +167,10 @@ class DataConfig:
     pixel_mean: tuple[float, float, float] = (123.675, 116.28, 103.53)
     pixel_std: tuple[float, float, float] = (58.395, 57.12, 57.375)
     aspect_grouping: bool = True
+    # VOC only: promote "difficult" objects to real gt instead of keeping
+    # them as flagged ignore regions (reference:
+    # ``rcnn/dataset/pascal_voc.py`` config.USE_DIFFICULT knob).
+    use_diff: bool = False
     # Parsed-roidb pickle cache directory (reference: imdb.gt_roidb caches
     # under data/cache/<name>_gt_roidb.pkl).  "" disables; entries are
     # invalidated by the annotation source's mtime.
@@ -168,15 +179,29 @@ class DataConfig:
 
 @dataclass(frozen=True)
 class ScheduleConfig:
-    """MultiFactor-style LR schedule (reference: lr_scheduler in drivers)."""
+    """MultiFactor-style LR schedule (reference: lr_scheduler in drivers).
 
-    base_lr: float = 0.02  # for global batch 16; scaled linearly
+    ``decay_steps``/``total_steps`` are denominated at a global batch of
+    ``reference_batch`` images; ``build_all`` rescales them by
+    ``reference_batch / global_batch`` alongside the linear lr scaling, so
+    a preset trains the same number of EPOCHS at any pod size (the
+    reference's drivers likewise scale lr by ``len(ctx) * kv.num_workers``
+    while keeping epoch-denominated schedules).  ``reference_batch = 0``
+    disables both rescalings' step side (steps are absolute; lr still
+    scales by global_batch/16) — used by the tiny test preset whose golden
+    numbers pin absolute step counts.  ``warmup_steps`` stays absolute
+    (warmup guards the first optimizer steps, however large the batch).
+    """
+
+    base_lr: float = 0.02  # for global batch `reference_batch`; scaled linearly
     warmup_steps: int = 500
     warmup_factor: float = 1.0 / 3.0
-    # Steps at which lr is multiplied by `factor` (in units of train steps).
+    # Steps at which lr is multiplied by `factor` (in units of train steps
+    # at reference_batch).
     decay_steps: tuple[int, ...] = (60000, 80000)
     factor: float = 0.1
     total_steps: int = 90000
+    reference_batch: int = 16
 
 
 @dataclass(frozen=True)
@@ -364,7 +389,11 @@ _register(
         ),
         train=TrainConfig(
             schedule=ScheduleConfig(
-                base_lr=0.01, warmup_steps=10, decay_steps=(400,), total_steps=500
+                base_lr=0.01, warmup_steps=10, decay_steps=(400,),
+                total_steps=500,
+                # Absolute steps: the golden overfit numbers pin this
+                # preset's exact step count on the 8-device fake mesh.
+                reference_batch=0,
             ),
             checkpoint_every=250,
         ),

@@ -322,29 +322,37 @@ class _CachedRoidb:
 
 
 def _mtime_fingerprint(path: str):
-    """mtime of one file, or None if unreadable (→ cache bypass)."""
+    """mtime_ns+size of one file, or None if unreadable (→ cache bypass).
+    Nanosecond mtime plus size closes the same-second-edit window and the
+    replaced-with-older-copy case a bare integer-second mtime misses."""
     try:
-        return str(int(os.stat(path).st_mtime))
+        st = os.stat(path)
+        return f"{st.st_mtime_ns}:{st.st_size}"
     except OSError:
         return None
 
 
 def _voc_fingerprint(devkit: str, index_file: str):
-    """ImageSets txt mtime + the NEWEST Annotations xml mtime: editing any
-    annotation invalidates (a directory's own mtime only changes on
-    add/remove, not edits)."""
+    """ImageSets txt mtime + the NEWEST Annotations xml mtime (plus file
+    count and total size): editing any annotation invalidates (a
+    directory's own mtime only changes on add/remove, not edits); the
+    count/size terms catch an annotation replaced with an older copy,
+    which a max-mtime alone would miss."""
     base = _mtime_fingerprint(index_file)
     if base is None:
         return None
-    newest = 0
+    newest = count = total = 0
     try:
         with os.scandir(os.path.join(devkit, "Annotations")) as it:
             for e in it:
                 if e.name.endswith(".xml"):
-                    newest = max(newest, int(e.stat().st_mtime))
+                    st = e.stat()
+                    newest = max(newest, st.st_mtime_ns)
+                    count += 1
+                    total += st.st_size
     except OSError:
         return None
-    return f"{base}|{newest}"
+    return f"{base}|{newest}:{count}:{total}"
 
 
 def build_dataset(cfg: DataConfig, split: Optional[str] = None, train: bool = True):
@@ -357,12 +365,20 @@ def build_dataset(cfg: DataConfig, split: Optional[str] = None, train: bool = Tr
         ann = os.path.join(cfg.root, "annotations", f"instances_{split}.json")
         fingerprint = lambda: _mtime_fingerprint(ann)  # noqa: E731
     elif cfg.dataset == "voc":
-        factory = lambda: VocDataset(cfg.root, split)  # noqa: E731
+        factory = lambda: VocDataset(  # noqa: E731
+            cfg.root, split, use_diff=cfg.use_diff
+        )
         name = "voc"
         year, imageset = split.split("_")
         devkit = os.path.join(cfg.root, f"VOC{year}")
         index = os.path.join(devkit, "ImageSets", "Main", f"{imageset}.txt")
-        fingerprint = lambda: _voc_fingerprint(devkit, index)  # noqa: E731
+        # use_diff changes the PARSE (difficult promoted to real gt), so it
+        # must key the roidb cache alongside the annotation fingerprint.
+        fingerprint = lambda: (  # noqa: E731
+            None
+            if (fp := _voc_fingerprint(devkit, index)) is None
+            else f"{fp}|diff{int(cfg.use_diff)}"
+        )
     else:
         raise ValueError(f"unknown dataset {cfg.dataset!r}")
     if cfg.cache_dir:

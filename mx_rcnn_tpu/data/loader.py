@@ -3,12 +3,13 @@
 Replaces ``rcnn/core/loader.py::AnchorLoader`` minus the anchor labeling
 (in-graph now).  Keeps the reference's load-time behaviors: epoch shuffle,
 aspect-ratio grouping (``ASPECT_GROUPING`` — portrait/landscape batched
-together so letterbox padding is minimized), flip augmentation, per-host
-sharding for data parallelism (the reference slices batches across
-``ctx`` GPUs; here each host process reads ``roidb[rank::world]`` and the
-mesh shards the global batch).  A one-deep background prefetch thread
-overlaps host decode with device compute (the reference relied on MXNet's
-threaded DataIter for the same).
+together so letterbox padding is minimized), flip augmentation, and
+per-host sharding for data parallelism — every host derives the SAME
+global batch schedule from the full roidb and decodes only its rank's
+rows of each global batch (lockstep by construction; the reference
+instead slices batches across ``ctx`` GPUs inside one process).  A
+one-deep background prefetch thread overlaps host decode with device
+compute (the reference relied on MXNet's threaded DataIter for the same).
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ from mx_rcnn_tpu.data.transforms import (
     hflip,
     letterbox,
     normalize_image,
+    oriented_canvas,
     resize_scale,
 )
 from mx_rcnn_tpu.detection.graph import Batch
@@ -132,13 +134,38 @@ class DetectionLoader:
         num_workers: Optional[int] = None,
         proposals: Optional[dict] = None,
         num_proposals: int = 1000,
+        run_length: int = 1,
     ) -> None:
         """``proposals``: image_id → {"boxes": (n, 4) ORIGINAL-image coords,
         "scores": (n,)} (the ``test.py --proposals`` pkl format) — shipped
         per batch as score-ordered, letterbox-scaled, padded ext_rois for
         Fast R-CNN training/testing (reference ``ROIIter``).  Boxes are
-        truncated/padded to the static ``num_proposals``."""
-        self.roidb = list(roidb[rank::world]) if world > 1 else list(roidb)
+        truncated/padded to the static ``num_proposals``.
+
+        ``run_length``: emit training batches in runs of this many
+        consecutive SAME-CANVAS batches (steps_per_call stacking needs K
+        identically-shaped batches per device call).  Irrelevant for
+        square canvases — every batch shares the shape anyway."""
+        # The flag decides the Batch pytree structure (gt_ignore present or
+        # None) and therefore the jitted program, so it is computed over
+        # the full roidb — every host must agree even when all the ignore
+        # regions happen to land in one host's rows.
+        self.with_ignore = any(r.ignore_flags.any() for r in roidb)
+        # Every host keeps the FULL roidb and derives the SAME global batch
+        # schedule (shuffle, orientation buckets, flips); a host then
+        # assembles only its rank's rows of each global batch.  Per-host
+        # roidb slices would desync multi-host runs the moment schedules
+        # depend on per-shard content (orientation buckets emit different
+        # canvases at the same step) — global-schedule + row-slicing keeps
+        # per-step collectives in lockstep by construction, for training
+        # and eval alike.  Pixels are only ever decoded for local rows.
+        self.roidb = list(roidb)
+        self._rank = rank
+        self._world = world
+        if world > 1 and batch_size % world:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by world={world}"
+            )
         self.cfg = cfg
         self.batch_size = batch_size
         self.train = train
@@ -156,6 +183,17 @@ class DetectionLoader:
         self.num_workers = num_workers if train else 0
         self.proposals = proposals
         self.num_proposals = num_proposals
+        self.run_length = max(run_length, 1)
+        ch, cw = cfg.image_size
+        self._square_canvas = ch == cw
+        if not self._square_canvas and train and not cfg.aspect_grouping:
+            # Mixed-orientation batches cannot stack into one static canvas;
+            # the orientation-bucketed recipe requires the reference's
+            # ASPECT_GROUPING (on by default).
+            raise ValueError(
+                "non-square image_size (orientation-bucketed canvases) "
+                "requires data.aspect_grouping=true"
+            )
         if proposals is not None:
             missing = [r.image_id for r in self.roidb if r.image_id not in proposals]
             if missing:
@@ -165,33 +203,53 @@ class DetectionLoader:
                 )
         if not self.roidb:
             raise ValueError("empty roidb shard")
-        # Datasets without any ignore regions ship gt_ignore=None so the
-        # train graph keeps the cheaper no-IoA form (the flag decides the
-        # jitted program's pytree structure, so it must be per-run, not
-        # per-batch).
-        self.with_ignore = any(r.ignore_flags.any() for r in self.roidb)
 
     # -- ordering ----------------------------------------------------------
 
-    def _epoch_order(self, epoch: int) -> np.ndarray:
+    def _epoch_batches(self, epoch: int) -> list[np.ndarray]:
+        """Shuffled FULL batches for one epoch, each single-orientation
+        under aspect grouping (so every batch maps to one static canvas),
+        grouped into runs of ``run_length`` same-orientation batches
+        (stacked steps_per_call calls need identically-shaped batches).
+        A group's tail that can't fill a batch (or a run) is padded by
+        wrapping within the group — a small orientation group slightly
+        oversamples rather than silently starving (the reference pads its
+        final batch the same wrap-around way)."""
         n = len(self.roidb)
+        bs = self.batch_size
         rng = np.random.RandomState(self.seed + epoch)
         if not self.cfg.aspect_grouping:
-            return rng.permutation(n)
+            order = rng.permutation(n)
+            return [order[i:i + bs] for i in range(0, n - bs + 1, bs)]
         # Reference ASPECT_GROUPING: batch wide with wide, tall with tall.
         aspects = np.array([r.aspect for r in self.roidb])
-        horz = np.flatnonzero(aspects >= 1)
-        vert = np.flatnonzero(aspects < 1)
-        rng.shuffle(horz)
-        rng.shuffle(vert)
-        inds = np.concatenate([horz, vert])
-        # Shuffle whole batches so groups stay contiguous.
-        nb = n // self.batch_size
-        if nb > 0:
-            batches = inds[: nb * self.batch_size].reshape(nb, self.batch_size)
-            batches = batches[rng.permutation(nb)]
-            inds = np.concatenate([batches.reshape(-1), inds[nb * self.batch_size:]])
-        return inds
+        # Same-canvas run grouping only matters when orientations map to
+        # different canvases; square canvases keep run=1 so the batch
+        # schedule is IDENTICAL for any steps_per_call (a pinned property:
+        # the scan loop must train bit-like the sequential loop).
+        run = 1 if self._square_canvas else self.run_length
+        runs: list[list[np.ndarray]] = []
+        for group in (np.flatnonzero(aspects >= 1), np.flatnonzero(aspects < 1)):
+            if len(group) == 0:
+                continue
+            rng.shuffle(group)
+            batches = [
+                group[i:i + bs] for i in range(0, len(group) - bs + 1, bs)
+            ]
+            if len(group) % bs:
+                # Wrap-around fill of the group's tail batch.
+                batches.append(
+                    np.resize(group, (len(batches) + 1) * bs)[-bs:]
+                )
+            if len(batches) % run:
+                # Wrap whole batches to complete the final run.
+                need = run - len(batches) % run
+                batches.extend(batches[i % len(batches)] for i in range(need))
+            runs.extend(
+                batches[i:i + run] for i in range(0, len(batches), run)
+            )
+        rng.shuffle(runs)
+        return [b for r in runs for b in r]
 
     # -- single image ------------------------------------------------------
 
@@ -200,6 +258,7 @@ class DetectionLoader:
         boxes = rec.boxes
         if flip:
             img, boxes = hflip(img, boxes, rec.width)
+        canvas = self.record_canvas(rec)
         scale = self.record_scale(rec)
         nh = int(round(rec.height * scale))
         nw = int(round(rec.width * scale))
@@ -211,7 +270,7 @@ class DetectionLoader:
             from mx_rcnn_tpu.native import letterbox_normalize
 
             native = letterbox_normalize(
-                img, self.cfg.image_size, nh, nw, scale,
+                img, canvas, nh, nw, scale,
                 self.cfg.pixel_mean, self.cfg.pixel_std,
             )
         if native is not None:
@@ -220,7 +279,7 @@ class DetectionLoader:
             th, tw = nh, nw
         else:
             img, boxes, scale, (th, tw) = letterbox(
-                img.astype(np.float32), boxes, self.cfg.image_size,
+                img.astype(np.float32), boxes, canvas,
                 self.cfg.short_side, self.cfg.max_side,
             )
             img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
@@ -302,18 +361,28 @@ class DetectionLoader:
     # -- iteration ---------------------------------------------------------
 
     def _batch_specs(self):
-        """Infinite (records, flips) stream in epoch order."""
+        """Infinite (records, flips) stream in GLOBAL epoch order.
+
+        The schedule (shuffle order, flip draws) is derived identically on
+        every host; multi-host runs slice each global spec to their rank's
+        rows (``_local_rows``), so the flip rng must be consumed for the
+        full global batch here, not per local slice."""
         epoch = 0
         rng = np.random.RandomState(self.seed + 17)
         while True:
-            order = self._epoch_order(epoch)
-            for i in range(0, len(order) - self.batch_size + 1, self.batch_size):
-                recs = [self.roidb[j] for j in order[i : i + self.batch_size]]
+            for batch_idx in self._epoch_batches(epoch):
+                recs = [self.roidb[j] for j in batch_idx]
                 flips = [
                     self.cfg.flip and bool(rng.randint(2)) for _ in recs
                 ]
                 yield recs, flips
             epoch += 1
+
+    def _local_rows(self, recs, flips):
+        """This host's rows of a global (records, flips) spec."""
+        local = self.batch_size // self._world
+        lo = self._rank * local
+        return recs[lo:lo + local], flips[lo:lo + local]
 
     def _train_batches(self, skip_batches: int = 0) -> Iterator[Batch]:
         specs = self._batch_specs()
@@ -324,7 +393,7 @@ class DetectionLoader:
             next(specs)
         if self.num_workers <= 1:
             for recs, flips in specs:
-                yield self._assemble(recs, flips)
+                yield self._assemble(*self._local_rows(recs, flips))
             return
         # Worker pool assembling num_workers batches ahead, yielded in
         # order.  Decode/resize/normalize release the GIL (cv2 and the C++
@@ -335,21 +404,45 @@ class DetectionLoader:
 
         with ThreadPoolExecutor(self.num_workers) as pool:
             pending = collections.deque(
-                pool.submit(self._assemble, *next(specs))
+                pool.submit(self._assemble, *self._local_rows(*next(specs)))
                 for _ in range(self.num_workers)
             )
             while True:
-                pending.append(pool.submit(self._assemble, *next(specs)))
+                pending.append(
+                    pool.submit(self._assemble, *self._local_rows(*next(specs)))
+                )
                 yield pending.popleft().result()
 
     def _eval_batches(self):
-        n = len(self.roidb)
-        for i in range(0, n, self.batch_size):
-            recs = self.roidb[i : i + self.batch_size]
-            pad = self.batch_size - len(recs)
-            padded = recs + [recs[-1]] * pad
-            batch = self._assemble(padded, [False] * len(padded))
-            yield batch, recs
+        # Non-square canvases: evaluate landscape images first, then
+        # portrait, each in roidb order — every batch shares one canvas
+        # (two compiled eval programs).  Detections map back through the
+        # yielded recs, so the reordering is invisible to the evaluator.
+        #
+        # Multi-host (world > 1): every host walks the SAME global schedule
+        # derived from the full roidb, assembles only its rank's rows of
+        # each padded global batch, and yields that local slice together
+        # with the global batch's records — per-step collectives stay in
+        # lockstep by construction, and rank-local batches concatenate into
+        # exactly the single-host global batch (shard_batch assembles them
+        # into one global array).
+        rank, world = self._rank, self._world
+        local = self.batch_size // world
+        if self._square_canvas:
+            groups = [self.roidb]
+        else:
+            groups = [
+                [r for r in self.roidb if r.aspect >= 1],
+                [r for r in self.roidb if r.aspect < 1],
+            ]
+        for group in groups:
+            for i in range(0, len(group), self.batch_size):
+                recs = group[i : i + self.batch_size]
+                pad = self.batch_size - len(recs)
+                padded = recs + [recs[-1]] * pad
+                rows = padded[rank * local : (rank + 1) * local]
+                batch = self._assemble(rows, [False] * len(rows))
+                yield batch, recs
 
     def __iter__(self):
         return self.iter_from()
@@ -365,13 +458,21 @@ class DetectionLoader:
             return it
         return _prefetched(it, depth=2)
 
+    def record_canvas(self, rec: RoiRecord) -> tuple[int, int]:
+        """The static canvas this record letterboxes into (orientation-
+        matched transpose of ``cfg.image_size`` for portrait images)."""
+        return oriented_canvas(self.cfg.image_size, rec.height, rec.width)
+
     def record_scale(self, rec: RoiRecord) -> float:
         """The letterbox scale applied to a record (for box un-scaling at
-        eval, the reference's ``/ im_scale`` in ``im_detect``)."""
+        eval, the reference's ``/ im_scale`` in ``im_detect``).  With an
+        orientation-matched canvas sized for the short/max rule the clamp
+        terms only guard rounding — the recipe scale always fits."""
+        ch, cw = self.record_canvas(rec)
         return min(
             resize_scale(rec.height, rec.width, self.cfg.short_side, self.cfg.max_side),
-            self.cfg.image_size[0] / rec.height,
-            self.cfg.image_size[1] / rec.width,
+            ch / rec.height,
+            cw / rec.width,
         )
 
 

@@ -17,6 +17,23 @@ except Exception:  # pragma: no cover
     cv2 = None
 
 
+def oriented_canvas(canvas_hw: tuple[int, int], h: int, w: int) -> tuple[int, int]:
+    """The static canvas for an image of true size (h, w).
+
+    ``canvas_hw`` is the LANDSCAPE canvas (h <= w); portrait images use its
+    transpose.  Two canvases instead of one square: a square canvas sized
+    for the short side silently under-resolves the reference recipe's
+    short/max rule (e.g. 480x640 COCO into 1024^2 lands at short side 768,
+    not 800), while a single canvas sized for both orientations
+    (max x max) wastes ~1.7x the conv FLOPs.  ``aspect_grouping`` keeps
+    batches single-orientation, so each orientation is one compiled
+    program.  Square canvases are orientation-free (synthetic/tiny)."""
+    ch, cw = canvas_hw
+    if h > w and ch != cw:
+        return cw, ch
+    return ch, cw
+
+
 def resize_scale(h: int, w: int, short_side: int, max_side: int) -> float:
     """The reference's scale rule: short side → ``short_side`` unless that
     pushes the long side past ``max_side``."""

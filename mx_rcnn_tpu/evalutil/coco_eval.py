@@ -89,8 +89,8 @@ def _greedy_match_batched(
     makes later ties win); only if none clears the threshold may an
     available ignored gt match (the oracle's break rule — reaching the
     ignored block with a real candidate stops the scan).  Dets whose max
-    IoU misses the lowest threshold can never match (the max is invariant
-    to the per-problem column permutation) and are skipped.
+    IoU over every problem's gts misses the lowest threshold can never
+    match anywhere and are skipped.
 
     Args: ious (A, D, G); g_ignore, g_crowd (A, G).
     Returns: (dt_match (A, T, D), gt_match (A, T, G)).
@@ -107,7 +107,7 @@ def _greedy_match_batched(
     crowd_avail = (g_ignore & g_crowd)[:, None, :]  # crowd: matched-but-available
     aidx = np.arange(A)[:, None]
     tidx = np.arange(T)[None, :]
-    active = np.flatnonzero(ious[0].max(axis=1) >= thr.min())
+    active = np.flatnonzero(ious.max(axis=2).max(axis=0) >= thr.min())
     for d in active:
         iou_d = ious[:, d, None, :]                             # (A, 1, G)
         free = gt_match == 0                                    # (A, T, G)

@@ -14,12 +14,11 @@ from mx_rcnn_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from mx_rcnn_tpu.train.metrics import MetricAccumulator, Speedometer
+from mx_rcnn_tpu.train.metrics import Speedometer
 from mx_rcnn_tpu.train.optim import make_optimizer, make_schedule
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
 
 __all__ = [
-    "MetricAccumulator",
     "Speedometer",
     "TrainState",
     "create_train_state",

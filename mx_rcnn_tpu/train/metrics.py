@@ -20,27 +20,6 @@ import numpy as np
 log = logging.getLogger("mx_rcnn_tpu")
 
 
-class MetricAccumulator:
-    """Running means of scalar metrics between log points."""
-
-    def __init__(self) -> None:
-        self._sums: dict[str, float] = {}
-        self._count = 0
-
-    def update(self, metrics: dict) -> None:
-        for k, v in metrics.items():
-            self._sums[k] = self._sums.get(k, 0.0) + float(v)
-        self._count += 1
-
-    def summary(self) -> dict[str, float]:
-        n = max(self._count, 1)
-        return {k: s / n for k, s in self._sums.items()}
-
-    def reset(self) -> None:
-        self._sums.clear()
-        self._count = 0
-
-
 class Speedometer:
     """samples/sec + metric line, one per call (reference semantics via
     logging, not stdout).  The train loop decides the cadence — it calls
