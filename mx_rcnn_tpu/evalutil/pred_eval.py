@@ -20,19 +20,39 @@ from mx_rcnn_tpu.evalutil.detections import save_detections
 from mx_rcnn_tpu.evalutil.voc_eval import voc_mean_ap
 
 
+def device_eval_batches(loader: DetectionLoader, mesh=None):
+    """Yield (device-ready batch, records) from an eval loader.
+
+    Multi-process: the loader yields each host's slice of a global batch;
+    ``shard_batch`` assembles the global array over ``mesh`` (single
+    process feeds numpy straight to the jitted step's in_shardings).
+    Shared by the detection eval loop and the proposal dump."""
+    multiproc = jax.process_count() > 1
+    if multiproc and mesh is None:
+        raise ValueError("multi-process eval needs the mesh for shard_batch")
+    for batch, recs in loader:
+        batch = jax.tree_util.tree_map(np.asarray, batch)
+        if multiproc:
+            from mx_rcnn_tpu.parallel.mesh import shard_batch
+
+            batch = shard_batch(batch, mesh)
+        yield batch, recs
+
+
 def collect_detections(
     eval_step: Callable,
     variables,
     loader: DetectionLoader,
     progress: Optional[Callable[[int], None]] = None,
+    mesh=None,
 ) -> dict[str, dict]:
     """Run inference over the loader; → image_id → original-coord results."""
     from mx_rcnn_tpu.evalutil.postprocess import unletterbox_detections
 
     out: dict[str, dict] = {}
     done = 0
-    for batch, recs in loader:
-        dets = jax.device_get(eval_step(variables, jax.tree_util.tree_map(np.asarray, batch)))
+    for batch, recs in device_eval_batches(loader, mesh):
+        dets = jax.device_get(eval_step(variables, batch))
         for i, rec in enumerate(recs):
             out[rec.image_id] = unletterbox_detections(
                 dets.boxes[i], dets.scores[i], dets.classes[i], dets.valid[i],
@@ -174,11 +194,14 @@ def pred_eval(
     dump_path: Optional[str] = None,
     vis_dir: Optional[str] = None,
     vis_count: int = 10,
+    mesh=None,
 ) -> dict[str, float]:
-    per_image = collect_detections(eval_step, variables, loader)
-    if dump_path:
+    per_image = collect_detections(eval_step, variables, loader, mesh=mesh)
+    # Multi-host: every host holds the full (gathered) detections and
+    # computes identical metrics; artifacts are written once, by process 0.
+    if dump_path and jax.process_index() == 0:
         save_detections(dump_path, per_image)
-    if vis_dir:
+    if vis_dir and jax.process_index() == 0:
         n = visualize_detections(
             per_image, roidb, vis_dir, class_names, count=vis_count
         )

@@ -183,6 +183,19 @@ def multilevel_roi_align_pallas(
     n = flat.shape[0]
     c = feats[0].shape[-1]
     t = window
+    # Mosaic's HBM window slice needs the sublane (W) dim to be a multiple
+    # of 8; recipe canvases (800x1344) give odd widths at coarse levels
+    # (84/42/21 cells).  Pad those levels' W with zeros — geometry and
+    # extent masking below keep using the TRUE widths, so padded cells get
+    # zero interpolation weight and the result is unchanged.  The pads copy
+    # only the small coarse maps (P4+), nothing at P2/P3 scale.
+    ws_true = [f.shape[2] for f in feats]
+    feats = [
+        jnp.pad(f, ((0, 0), (0, 0), (0, -f.shape[2] % 8), (0, 0)))
+        if f.shape[2] % 8
+        else f
+        for f in feats
+    ]
 
     assignment = fpn_level_assignment(
         flat, min_level=levels[0], max_level=levels[-1],
@@ -193,7 +206,8 @@ def multilevel_roi_align_pallas(
     # Per-roi geometry in its level's cell units (gather per-level consts).
     scale = jnp.asarray([1.0 / (1 << l) for l in levels], jnp.float32)[level_idx]
     hs = jnp.asarray([f.shape[1] for f in feats], jnp.float32)[level_idx]
-    ws = jnp.asarray([f.shape[2] for f in feats], jnp.float32)[level_idx]
+    ws = jnp.asarray(ws_true, jnp.float32)[level_idx]
+    ws_pad = jnp.asarray([f.shape[2] for f in feats], jnp.float32)[level_idx]
     x1 = flat[:, 0] * scale
     y1 = flat[:, 1] * scale
     rw = jnp.maximum(flat[:, 2] * scale - x1, 1.0)
@@ -205,7 +219,7 @@ def multilevel_roi_align_pallas(
     # sublane alignment for HBM slices in the tiled (second-to-last) dim;
     # the up-to-7-cell loss is budgeted in max_extent_cells below.
     oy = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - t, 0)).astype(jnp.int32)
-    ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws - t, 0)).astype(jnp.int32)
+    ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - t, 0)).astype(jnp.int32)
     ox = (ox // 8) * 8
     bidx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), r_per)
     # Indices ride the same f32 table as the geometry (exact for values
@@ -254,19 +268,19 @@ def multilevel_roi_align_pallas(
 
 def pallas_supported(feature_pyramid: dict, window: int = 48) -> bool:
     """Static check that every level's layout is Mosaic-DMA-sliceable:
-    the x (sublane-tiled) dim must be a multiple of 8 — the window copy
-    slices both the HBM source and the VMEM scratch along it — and
-    channels a multiple of 128 (lane dim).  Single-level (C4) pyramids use
-    the XLA path (their roi extent is unbounded by level reassignment)."""
+    channels must be a multiple of 128 (lane dim).  The x (sublane-tiled)
+    dim, which the window copy slices, is zero-padded to a multiple of 8
+    inside the kernel wrapper, so odd widths (recipe canvases) are fine.
+    Single-level (C4) pyramids use the XLA path (their roi extent is
+    unbounded by level reassignment)."""
     for f in feature_pyramid.values():
-        w, c = f.shape[-2:]
-        if c % 128 != 0 or w % 8 != 0:
+        if f.shape[-1] % 128 != 0:
             return False
     return len(feature_pyramid) > 1
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(2, 3, 4)
+    jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5)
 )
 def multilevel_roi_align_fast(
     feature_pyramid: dict[int, jnp.ndarray],
@@ -274,6 +288,7 @@ def multilevel_roi_align_fast(
     output_size: int = 7,
     sampling_ratio: int = 2,
     window: int = 48,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas forward + XLA-reference backward.
 
@@ -282,21 +297,22 @@ def multilevel_roi_align_fast(
     the matching extent-aware level assignment), which is exact because
     both compute identical outputs.  Roi coordinates get no gradient (the
     reference's Proposal/ProposalTarget custom ops are forward-only too —
-    SURVEY.md §4.1)."""
+    SURVEY.md §4.1).  ``interpret`` runs the kernel's pure-JAX emulation
+    (CPU fake-mesh tests and the driver's multichip dryrun)."""
     return multilevel_roi_align_pallas(
         feature_pyramid, rois, output_size=output_size,
-        sampling_ratio=sampling_ratio, window=window,
+        sampling_ratio=sampling_ratio, window=window, interpret=interpret,
     )
 
 
-def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window):
+def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window, interpret):
     out = multilevel_roi_align_fast(
-        feature_pyramid, rois, output_size, sampling_ratio, window
+        feature_pyramid, rois, output_size, sampling_ratio, window, interpret
     )
     return out, (feature_pyramid, rois)
 
 
-def _fast_bwd(output_size, sampling_ratio, window, res, g):
+def _fast_bwd(output_size, sampling_ratio, window, interpret, res, g):
     from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
 
     feature_pyramid, rois = res
@@ -317,3 +333,44 @@ def _fast_bwd(output_size, sampling_ratio, window, res, g):
 
 
 multilevel_roi_align_fast.defvjp(_fast_fwd, _fast_bwd)
+
+
+def sharded_multilevel_roi_align(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int,
+    sampling_ratio: int,
+    mesh,
+    data_axis: str,
+    window: int = 48,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The kernel's multi-chip form: :func:`multilevel_roi_align_fast`
+    per data-axis shard via ``jax.shard_map``.
+
+    The batched kernel contract is already per-shard exact — each shard
+    holds whole images (pyramid (B/n, H, W, C) + rois (B/n, R, 4)) and
+    batch indices are computed from local shapes — so the wrap needs no
+    collectives; it only stops GSPMD from replicating the opaque kernel
+    call (gathering every image's pyramid to every chip), which is what a
+    bare pallas_call under a sharded jit would get.  Axes other than
+    ``data_axis`` stay under GSPMD (partial-manual shard_map).
+    ``check_vma=False``: the pallas out_shape carries no varying-mesh-axes
+    annotation.  The custom_vjp rides inside, so the backward (the XLA
+    reference) is per-shard too."""
+    from jax.sharding import PartitionSpec as P
+
+    # Positional call: custom_vjp nondiff_argnums forbid keywords.
+    def fn(pyramid, shard_rois):
+        return multilevel_roi_align_fast(
+            pyramid, shard_rois, output_size, sampling_ratio, window, interpret
+        )
+
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(data_axis), P(data_axis)),
+        out_specs=P(data_axis),
+        axis_names={data_axis},
+        check_vma=False,
+    )(feature_pyramid, rois)

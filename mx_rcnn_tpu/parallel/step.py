@@ -55,6 +55,11 @@ def make_train_step(
     spatial_spec = (
         spatial_sharding(mesh) if spatial and mesh is not None else None
     )
+    # The Pallas ROIAlign shard_map wrap needs the mesh at trace time.
+    # Spatial partitioning shards feature heights over the model axis — a
+    # layout the per-shard kernel contract doesn't cover — so those runs
+    # keep mesh=None here and the XLA path (see mesh_safe_model_cfg).
+    roi_mesh = mesh if (mesh is not None and not spatial) else None
 
     def step(state: TrainState, batch: Batch):
         if spatial_spec is not None:
@@ -73,7 +78,9 @@ def make_train_step(
                     trainable_mask,
                 )
             variables = {"params": params, **state.model_state}
-            total, metrics = forward_train(model, variables, rng, batch)
+            total, metrics = forward_train(
+                model, variables, rng, batch, mesh=roi_mesh
+            )
             return total, metrics
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
@@ -121,18 +128,18 @@ def make_train_step(
     )
 
 
-def mesh_safe_model_cfg(model_cfg, mesh):
-    """Model config adjusted for a >1-device mesh.
+def mesh_safe_model_cfg(model_cfg, mesh, spatial: bool = False):
+    """Model config adjusted for spatially-partitioned meshes.
 
-    The Pallas ROIAlign has no GSPMD partitioning rule: under a sharded
-    jit XLA would replicate the kernel call (gathering every image's
-    pyramid to every chip) instead of running it per-shard.  Until a
-    shard_map wrapping is validated on real multi-chip hardware, sharded
-    steps keep the XLA form (identical numerics — it is the kernel's
-    oracle).  Single-device meshes and mesh=None pass through unchanged.
+    Pure data-parallel meshes run the Pallas ROIAlign per-shard via
+    ``shard_map`` (graph.py::_pool_rois) — no downgrade.  Spatial
+    partitioning (model axis > 1) shards feature-map heights across chips,
+    which the per-shard kernel contract doesn't cover, so those runs use
+    the XLA form (identical numerics — it is the kernel's oracle).
     """
     if (
-        mesh is not None
+        spatial
+        and mesh is not None
         and mesh.size > 1
         and model_cfg.rcnn.roi_align_impl == "pallas"
     ):
@@ -145,18 +152,40 @@ def mesh_safe_model_cfg(model_cfg, mesh):
     return model_cfg
 
 
-def make_eval_step(model: TwoStageDetector, mesh: Optional[Mesh] = None):
+def make_sharded_infer(
+    fn, mesh: Optional[Mesh] = None, gather_outputs: bool = False
+):
+    """Jit an inference-shaped ``fn(variables, batch)`` for the mesh:
+    replicated params, data-sharded batch.  The one scaffolding shared by
+    eval, proposal dumps, and any future read-only pass.
+
+    ``gather_outputs``: replicate the outputs across the mesh (an XLA
+    all-gather at the step's end).  Multi-host runs need it — a host can
+    only ``device_get`` what it addresses, and detection/proposal outputs
+    are tiny next to the step's compute."""
+    if mesh is None:
+        return jax.jit(fn)
+    rep, data = replicated(mesh), batch_sharding(mesh)
+    # out_shardings is a single spec broadcast over the output pytree
+    # (a tuple here would be matched structurally and fail).
+    return jax.jit(
+        fn,
+        in_shardings=(rep, data),
+        out_shardings=rep if gather_outputs else data,
+    )
+
+
+def make_eval_step(
+    model: TwoStageDetector,
+    mesh: Optional[Mesh] = None,
+    gather_outputs: bool = False,
+):
     """Build ``eval_step(variables, batch) -> Detections`` (jitted)."""
 
     def step(variables, batch: Batch):
-        return forward_inference(model, variables, batch)
+        return forward_inference(model, variables, batch, mesh=mesh)
 
-    if mesh is None:
-        return jax.jit(step)
-    rep, data = replicated(mesh), batch_sharding(mesh)
-    # out_shardings is a single spec broadcast over the Detections pytree
-    # (a tuple here would be matched structurally and fail).
-    return jax.jit(step, in_shardings=(rep, data), out_shardings=data)
+    return make_sharded_infer(step, mesh, gather_outputs)
 
 
 def eval_variables(state: TrainState) -> dict:

@@ -49,6 +49,24 @@ FREEZE_PREFIXES = {
 }
 
 
+def scale_schedule_steps(sched, global_batch: int):
+    """Rescale step-denominated schedule fields by
+    ``reference_batch / global_batch`` (the step half of the linear-scaling
+    rule; see ScheduleConfig).  Identity when ``reference_batch`` is 0
+    (absolute steps) or already matches."""
+    import dataclasses as _dc
+
+    ref = sched.reference_batch
+    if not ref or global_batch == ref:
+        return sched
+    f = ref / global_batch
+    return _dc.replace(
+        sched,
+        decay_steps=tuple(max(1, round(s * f)) for s in sched.decay_steps),
+        total_steps=max(1, round(sched.total_steps * f)),
+    )
+
+
 def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
               extra_freeze: tuple[str, ...] = (),
               pretrained: Optional[str] = None):
@@ -59,9 +77,14 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     ImageNet ``.params`` file before training)."""
     from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
 
-    model_cfg = mesh_safe_model_cfg(cfg.model, mesh)
+    model_cfg = mesh_safe_model_cfg(
+        cfg.model, mesh, spatial=cfg.train.spatial_partition > 1
+    )
     if model_cfg is not cfg.model:
-        log.info("multi-chip mesh: using the XLA ROIAlign (pallas is 1-chip)")
+        log.info(
+            "spatial partitioning: using the XLA ROIAlign (the Pallas "
+            "kernel's shard_map wrap covers the data axis only)"
+        )
     model = TwoStageDetector(cfg=model_cfg)
     rng = jax.random.PRNGKey(cfg.train.seed)
     n_dev = mesh.size if mesh is not None else 1
@@ -81,14 +104,31 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     # With spatial partitioning, `sp` chips cooperate on each image: the
     # data axis shrinks by sp, and so does the global batch.
     global_batch = cfg.train.per_device_batch * (n_dev // sp)
-    lr_scale = global_batch / 16.0
+    # Linear-scaling rule, both halves: lr scales UP by global_batch/ref
+    # and the step-denominated schedule scales DOWN by ref/global_batch,
+    # so any pod size trains the same epochs (reference drivers:
+    # ``lr * len(ctx) * kv.num_workers`` with epoch schedules).
+    sched = scale_schedule_steps(cfg.train.schedule, global_batch)
+    train_cfg = cfg.train
+    if sched is not cfg.train.schedule:
+        import dataclasses as _dc
+
+        log.info(
+            "schedule rescaled for global batch %d (reference %d): "
+            "decay %s -> %s, total %d -> %d",
+            global_batch, cfg.train.schedule.reference_batch,
+            cfg.train.schedule.decay_steps, sched.decay_steps,
+            cfg.train.schedule.total_steps, sched.total_steps,
+        )
+        train_cfg = _dc.replace(cfg.train, schedule=sched)
+    lr_scale = global_batch / (sched.reference_batch or 16)
     freeze = ()
     if freeze_backbone and cfg.model.backbone.freeze_stages > 0:
         freeze = FREEZE_PREFIXES.get(cfg.model.backbone.name, ())
     freeze = tuple(freeze) + tuple(extra_freeze)
 
     # Init params first (on host) so the freeze mask can see the tree.
-    probe_tx, schedule = make_optimizer(cfg.train, None, lr_scale=lr_scale)
+    probe_tx, schedule = make_optimizer(train_cfg, None, lr_scale=lr_scale)
     state = create_train_state(model, probe_tx, rng, cfg.data.image_size, batch=1)
     if pretrained:
         from mx_rcnn_tpu.train.import_torch import load_pretrained_backbone
@@ -102,7 +142,7 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     trainable = None
     if freeze:
         tx, schedule = make_optimizer(
-            cfg.train, state.params, lr_scale=lr_scale, freeze_prefixes=freeze
+            train_cfg, state.params, lr_scale=lr_scale, freeze_prefixes=freeze
         )
         state = state.replace(opt_state=tx.init(state.params))
         # Same mask the optimizer uses: frozen leaves are stop-gradient'd
@@ -213,7 +253,13 @@ def train(
         state = fresh_state.replace(
             params=state.params, model_state=state.model_state
         )
-    steps = total_steps if total_steps is not None else cfg.train.schedule.total_steps
+    # Explicit total_steps is absolute (alternate phases, tests); the
+    # preset default is batch-scaled to keep epochs constant across pods.
+    steps = (
+        total_steps
+        if total_steps is not None
+        else scale_schedule_steps(cfg.train.schedule, global_batch).total_steps
+    )
     ckpt_dir = f"{workdir or cfg.workdir}/{cfg.name}/ckpt"
     if resume and latest_step(ckpt_dir) is not None:
         state = restore_checkpoint(ckpt_dir, state)
@@ -236,6 +282,9 @@ def train(
             with_masks=cfg.model.mask.enabled,
             proposals=proposals,
             num_proposals=cfg.model.rpn.train_post_nms_top_n,
+            # Stacked steps_per_call calls scan K batches in one device
+            # program — the loader must emit K same-canvas batches per run.
+            run_length=max(cfg.train.steps_per_call, 1),
         )
     if mesh is not None:
         state = jax.device_put(state, replicated(mesh))

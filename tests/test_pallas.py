@@ -80,6 +80,35 @@ class TestPallasRoiAlign:
         )
 
 
+    def test_odd_width_levels_match_xla(self, rng):
+        """Recipe canvases (800x1344) give coarse levels whose width is NOT
+        a multiple of 8 (84/42/21 cells); the kernel zero-pads W internally
+        and must still match the XLA reference bit-for-bit in masking."""
+        h, w = 400, 672  # 1/2-scale stand-in for the 800x1344 canvas
+        pyr = {
+            l: jnp.asarray(
+                rng.rand(-(-h // (1 << l)), -(-w // (1 << l)), 8), jnp.float32
+            )
+            for l in (2, 3, 4, 5)
+        }
+        assert any(f.shape[1] % 8 for f in pyr.values())  # test premise
+        ctr = rng.rand(48, 2) * np.array([w, h])
+        size = 2.0 ** rng.uniform(2, 8, size=(48, 2))
+        x1 = np.clip(ctr[:, 0] - size[:, 0] / 2, 0, w - 2)
+        y1 = np.clip(ctr[:, 1] - size[:, 1] / 2, 0, h - 2)
+        rois = jnp.asarray(
+            np.stack(
+                [x1, y1, np.clip(x1 + size[:, 0], x1 + 1, w - 1),
+                 np.clip(y1 + size[:, 1], y1 + 1, h - 1)], 1
+            ),
+            jnp.float32,
+        )
+        ref = multilevel_roi_align(pyr, rois, output_size=7, sampling_ratio=2)
+        out = multilevel_roi_align_pallas(
+            pyr, rois, output_size=7, sampling_ratio=2, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
     def test_batched_matches_per_image(self, rng):
         """(B, R, 4) rois + (B, H, W, C) pyramid in ONE kernel launch equals
         the per-image calls it replaced."""
@@ -122,7 +151,7 @@ class TestPallasRoiAlign:
         # Call the registered backward directly (the forward needs a TPU).
         out_shape = (b, 8, 7, 7, pyr[2].shape[-1])
         g = jnp.ones(out_shape, jnp.float32)
-        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, (pyr, rois), g)
+        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, False, (pyr, rois), g)
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(grad_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
@@ -149,7 +178,7 @@ class TestPallasRoiAlign:
         from mx_rcnn_tpu.ops.pallas import roi_align as pra
 
         g_pyr, g_rois = pra._fast_bwd(
-            7, 2, 48, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
+            7, 2, 48, False, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
         )
         for l in pyr:
             np.testing.assert_allclose(

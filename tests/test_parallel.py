@@ -157,6 +157,86 @@ class TestShardedEval:
             assert np.isclose(multi[k], v, atol=1e-5), (k, multi[k], v)
 
 
+class TestShardedPallasRoiAlign:
+    """VERDICT r2 #2: the Pallas ROIAlign rides shard_map on >1-chip data
+    meshes (interpret mode on the fake CPU mesh runs the real grid/DMA
+    logic); numerics must match the XLA path it replaced."""
+
+    def test_sharded_helper_matches_vmapped_xla(self, rng):
+        from mx_rcnn_tpu.ops.pallas.roi_align import sharded_multilevel_roi_align
+        from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
+        from mx_rcnn_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = make_mesh()
+        b, r = 8, 16
+        pyr = {
+            l: jnp.asarray(
+                rng.rand(b, 64 >> (l - 2), 88 >> (l - 2), 128), jnp.float32
+            )
+            for l in (2, 3, 4, 5)
+        }
+        rois = np.asarray(rng.rand(b, r, 4) * 50, np.float32)
+        rois[..., 2:] = rois[..., :2] + 10 + rng.rand(b, r, 2) * 40
+        rois = jnp.asarray(rois)
+        out = jax.jit(
+            lambda p, rr: sharded_multilevel_roi_align(
+                p, rr, 7, 2, mesh, DATA_AXIS, interpret=True
+            )
+        )(pyr, rois)
+        ref = jax.vmap(
+            lambda p, rr: multilevel_roi_align(
+                p, rr, output_size=7, sampling_ratio=2
+            )
+        )(pyr, rois)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4
+        )
+
+    def test_sharded_train_step_pallas_matches_xla(self, monkeypatch):
+        """Full sharded train step, pallas-shardmap vs xla backend: same
+        seed, same batch, (near-)identical metrics — and the trace must
+        actually take the shard_map path, not silently fall back."""
+        import dataclasses
+
+        from mx_rcnn_tpu.detection import graph
+        from mx_rcnn_tpu.train.loop import build_all
+
+        mesh = make_mesh()
+        roidb = SyntheticDataset(num_images=8, image_hw=(128, 128)).roidb()
+
+        def one_step(impl):
+            cfg = get_config("tiny_synthetic")
+            cfg = dataclasses.replace(
+                cfg,
+                model=dataclasses.replace(
+                    cfg.model,
+                    rcnn=dataclasses.replace(
+                        cfg.model.rcnn, roi_align_impl=impl
+                    ),
+                ),
+            )
+            model, tx, state, step_fn, gb = build_all(cfg, mesh)
+            loader = DetectionLoader(
+                roidb, cfg.data, batch_size=gb, train=True, seed=0,
+                prefetch=False, num_workers=0,
+            )
+            state = jax.device_put(state, replicated(mesh))
+            batch = shard_batch(next(iter(loader)), mesh)
+            state, metrics = step_fn(state, batch)
+            return {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+        monkeypatch.setenv("MX_RCNN_PALLAS_INTERPRET", "1")
+        graph.LAST_POOL_IMPL = None
+        pallas_metrics = one_step("pallas")
+        assert graph.LAST_POOL_IMPL == "pallas-shardmap"
+        xla_metrics = one_step("xla")
+        assert graph.LAST_POOL_IMPL == "xla"
+        for k in xla_metrics:
+            assert np.isclose(pallas_metrics[k], xla_metrics[k], atol=1e-4), (
+                k, pallas_metrics[k], xla_metrics[k],
+            )
+
+
 class TestSpatialPartition:
     """Spatial (height-axis) partitioning — the CNN analog of sequence
     parallelism: convs sharded over chips with XLA halo exchange."""

@@ -38,6 +38,57 @@ class TestSchedule:
         s = make_schedule(cfg, scale=8.0)
         assert np.isclose(float(s(10)), 0.08)
 
+    def test_scale_schedule_steps(self):
+        from mx_rcnn_tpu.train.loop import scale_schedule_steps
+
+        sched = ScheduleConfig(
+            decay_steps=(60000, 80000), total_steps=90000, reference_batch=16
+        )
+        out = scale_schedule_steps(sched, 64)
+        assert out.decay_steps == (15000, 20000)
+        assert out.total_steps == 22500
+        # Identity cases: matching batch, and absolute-steps presets.
+        assert scale_schedule_steps(sched, 16) is sched
+        absolute = dataclasses.replace(sched, reference_batch=0)
+        assert scale_schedule_steps(absolute, 64) is absolute
+
+    @pytest.mark.skipif(
+        jax.device_count() < 8, reason="needs the 8-device fake mesh"
+    )
+    def test_build_all_linear_scaling_rule(self, monkeypatch):
+        """VERDICT r2 #6: a 64-global-batch run must train 1/4 the steps at
+        4x lr — both halves applied by build_all, visibly to the optimizer."""
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.parallel import make_mesh
+        from mx_rcnn_tpu.train import loop as L
+
+        captured = {}
+        orig = L.make_optimizer
+
+        def spy(train_cfg, params, lr_scale=1.0, **kw):
+            captured["sched"] = train_cfg.schedule
+            captured["lr_scale"] = lr_scale
+            return orig(train_cfg, params, lr_scale=lr_scale, **kw)
+
+        monkeypatch.setattr(L, "make_optimizer", spy)
+        cfg = get_config("tiny_synthetic")
+        cfg = dataclasses.replace(
+            cfg,
+            train=dataclasses.replace(
+                cfg.train,
+                per_device_batch=8,  # 8 fake devices -> global batch 64
+                schedule=ScheduleConfig(
+                    decay_steps=(60000, 80000), total_steps=90000,
+                    reference_batch=16,
+                ),
+            ),
+        )
+        *_, gb = L.build_all(cfg, make_mesh())
+        assert gb == 64
+        assert np.isclose(captured["lr_scale"], 4.0)
+        assert captured["sched"].decay_steps == (15000, 20000)
+        assert captured["sched"].total_steps == 22500
+
 
 class TestFrozenMask:
     def test_prefix_freezing(self):

@@ -8,7 +8,12 @@ queued executions ended by ONE device->host fetch — see BASELINE.md's
 timing-method warning: block_until_ready returns at dispatch under the
 tunnel; the fetch of the last result waits on the whole queue.
 
-Usage: python tools/perf_breakdown.py [--hw 1024] [--steps 20]
+Also times the full optimizer step (make_train_step minus the ablation
+grad — optimizer/update overhead) and standalone micro-benches of the
+usual non-MXU suspects (per-level proposal NMS fixed point, the big
+anchor top_k) so the largest delta line can be attributed inside itself.
+
+Usage: python tools/perf_breakdown.py [--hw 800x1344] [--batch 2] [--steps 20]
 """
 
 from __future__ import annotations
@@ -50,7 +55,11 @@ def timed(fn, arg, n):
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--hw", type=int, default=1024)
+    ap.add_argument(
+        "--hw", default="800x1344",
+        help="canvas as HxW (recipe default) or one square int",
+    )
+    ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--config", default="r50_fpn_coco")
     ap.add_argument(
@@ -72,28 +81,32 @@ def main() -> None:
     )
     from mx_rcnn_tpu.ops import sample_rois
 
-    hw = args.hw
+    if "x" in args.hw:
+        h, w = (int(t) for t in args.hw.split("x"))
+    else:
+        h = w = int(args.hw)
+    b = args.batch
     cfg = get_config(args.config)
     cfg = apply_overrides(
         cfg,
-        [f"data.image_size=({hw},{hw})", "data.max_gt_boxes=32"]
+        [f"data.image_size=({h},{w})", "data.max_gt_boxes=32"]
         + args.overrides,
     )
     model = TwoStageDetector(cfg=cfg.model)
-    variables = init_detector(model, jax.random.PRNGKey(0), (hw, hw))
+    variables = init_detector(model, jax.random.PRNGKey(0), (h, w))
     params = variables["params"]
     rest = {k: v for k, v in variables.items() if k != "params"}
 
     rng = np.random.RandomState(0)
     g = cfg.data.max_gt_boxes
-    boxes = np.zeros((1, g, 4), np.float32)
+    boxes = np.zeros((b, g, 4), np.float32)
     boxes[:, :8] = [100.0, 100.0, 300.0, 300.0]
     batch = Batch(
-        images=jnp.asarray(rng.randn(1, hw, hw, 3), jnp.float32),
-        image_hw=jnp.full((1, 2), float(hw), jnp.float32),
+        images=jnp.asarray(rng.randn(b, h, w, 3), jnp.float32),
+        image_hw=jnp.asarray([[float(h), float(w)]] * b, jnp.float32),
         gt_boxes=jnp.asarray(boxes),
-        gt_classes=jnp.ones((1, g), jnp.int32),
-        gt_valid=jnp.asarray(np.arange(g)[None] < 8),
+        gt_classes=jnp.ones((b, g), jnp.int32),
+        gt_valid=jnp.asarray(np.tile(np.arange(g)[None] < 8, (b, 1))),
     )
     key = jax.random.PRNGKey(1)
     mcfg = cfg.model
@@ -122,7 +135,7 @@ def main() -> None:
             lambda k, gt, gv, hw_: assign_anchors_cfg(
                 mcfg, k, anchors_cat, gt, gv, hw_[0], hw_[1]
             )
-        )(key[None].repeat(1, 0), batch.gt_boxes, batch.gt_valid, batch.image_hw)
+        )(jax.random.split(key, b), batch.gt_boxes, batch.gt_valid, batch.image_hw)
         rpn_cls, rpn_box, _ = _rpn_losses(logits, deltas, targets)
         loss = rpn_cls + rpn_box
         if upto == "rpnloss":
@@ -144,7 +157,7 @@ def main() -> None:
                 bg_iou_lo=mcfg.rcnn.bg_iou_lo,
                 bbox_weights=mcfg.rcnn.bbox_weights,
             )
-        )(key[None].repeat(1, 0), props.rois, props.valid, batch.gt_boxes,
+        )(jax.random.split(key, b), props.rois, props.valid, batch.gt_boxes,
           batch.gt_classes, batch.gt_valid)
         if upto == "sample":
             return loss + jnp.sum(samples.rois) * 1e-30
@@ -174,11 +187,79 @@ def main() -> None:
         dt = timed(grad, params, args.steps)
         results.append((name, dt))
         print(f"{name:32s} {dt * 1e3:8.2f} ms/step", flush=True)
+
+    # Full production step incl. optimizer (delta vs the grad-only full
+    # stage = clip + wd + sgd + state bookkeeping).
+    from mx_rcnn_tpu.parallel.step import make_train_step
+    from mx_rcnn_tpu.train.loop import FREEZE_PREFIXES
+    from mx_rcnn_tpu.train.optim import frozen_mask, make_optimizer
+    from mx_rcnn_tpu.train.state import create_train_state
+
+    freeze = FREEZE_PREFIXES.get(cfg.model.backbone.name, ())
+    tx, schedule = make_optimizer(cfg.train, params, freeze_prefixes=freeze)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), (h, w), batch=1)
+    state = state.replace(params=params, model_state=rest)
+    step_fn = make_train_step(
+        model, tx, schedule,
+        trainable_mask=frozen_mask(params, freeze) if freeze else None,
+    )
+
+    # timed() feeds fn its own output; close over state and chain via params.
+    def opt_fn(p):
+        new_s, _ = step_fn(state.replace(params=p), batch)
+        return new_s.params
+
+    dt = timed(jax.jit(opt_fn), params, args.steps)
+    results.append(("full step + optimizer", dt))
+    print(f"{'full step + optimizer':32s} {dt * 1e3:8.2f} ms/step", flush=True)
+
     print("\ndeltas vs previous stage:")
     prev = None
     for name, dt in results:
         print(f"{name:32s} +{(dt - (prev if prev is not None else dt)) * 1e3:7.2f} ms")
         prev = dt
+
+    # ---- standalone micro-benches of the usual non-MXU suspects ---------
+    print("\nisolated micro-benches (forward only, per step):")
+    from mx_rcnn_tpu.ops.nms import nms_indices
+
+    feats = model.apply({"params": params, **rest}, batch.images,
+                        method="features")
+    anchors = level_anchors(mcfg, feats)
+    n_anchors = int(sum(a.shape[0] for a in anchors.values()))
+
+    # timed() chains fn's output back into its argument, so each micro fn
+    # returns an argument-shaped value that depends on the measured op.
+    pre = mcfg.rpn.train_pre_nms_top_n
+
+    # The big per-image objectness top_k over all anchors.
+    scores_all = jnp.asarray(rng.rand(b, n_anchors), jnp.float32)
+    topk = jax.jit(
+        lambda s: s + 0.0 * jax.lax.top_k(s, pre)[0].sum()
+    )
+    dt = timed(topk, scores_all, args.steps)
+    print(f"  top_k({n_anchors} anchors -> {pre}) x{b}   {dt*1e3:8.2f} ms")
+
+    # One per-level NMS fixed point at the proposal count (the train path
+    # runs one of these per FPN level per image).
+    k = pre
+    bx = jnp.asarray(rng.rand(b, k, 4) * 800, jnp.float32)
+    bx = bx.at[..., 2:].set(bx[..., :2] + 8 + 120 * rng.rand(b, k, 2))
+    post = mcfg.rpn.train_post_nms_top_n
+    nms1 = jax.jit(
+        lambda s: s + 0.0 * jax.vmap(
+            lambda bb, ss: nms_indices(
+                bb, ss, mcfg.rpn.nms_threshold, post
+            )[0].astype(jnp.float32).sum()
+        )(bx, s)[:, None]
+    )
+    sc = jnp.asarray(rng.rand(b, k), jnp.float32)
+    dt = timed(nms1, sc, args.steps)
+    n_lvl = len(model.feature_levels)
+    print(
+        f"  NMS fixed point ({k} boxes) x{b} imgs  {dt*1e3:8.2f} ms"
+        f"  (train path runs {n_lvl} levels/img)"
+    )
 
 
 if __name__ == "__main__":
