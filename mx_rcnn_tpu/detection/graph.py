@@ -35,6 +35,7 @@ from mx_rcnn_tpu.geometry import (
 from mx_rcnn_tpu.ops import assign_anchors, generate_proposals, roi_align, sample_rois
 from mx_rcnn_tpu.ops.nms import nms_indices
 from mx_rcnn_tpu.ops.pallas.roi_align import (
+    POOL_WINDOW,
     multilevel_roi_align_fast,
     pallas_supported,
     sharded_multilevel_roi_align,
@@ -235,9 +236,11 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set,
     ``cfg.rcnn.roi_align_impl`` picks the backend: "pallas" (default — ONE
     batch-folded kernel launch per step; measured 83.1 -> 77.6 ms on the
     full R50-FPN train step, 219.5 -> 118.8 ms on the batch-8 eval step)
-    or "xla" (flattened-pyramid gather — the oracle, the backward, and the
-    automatic fallback off-TPU, on single-level C4 pyramids, and on
-    unsupported layouts).
+    or "xla" (flattened-pyramid gather — the oracle and the automatic
+    fallback off-TPU, on single-level C4 pyramids, and on unsupported
+    layouts).  Since r3 the pallas path's backward is a Pallas window-RMW
+    kernel too (ops/pallas/roi_align.py::_bwd_kernel; MX_RCNN_POOL_BWD=xla
+    restores the autodiff-of-XLA backward).
 
     ``mesh``: a >1-data-axis mesh wraps the kernel in ``shard_map`` so each
     chip pools its own images (the kernel's per-shard contract) instead of
@@ -287,7 +290,7 @@ def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set,
             LAST_POOL_IMPL = "pallas"
             return multilevel_roi_align_fast(
                 roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio,
-                48, interpret,
+                POOL_WINDOW, interpret,
             )
         LAST_POOL_IMPL = "xla"
         return jax.vmap(

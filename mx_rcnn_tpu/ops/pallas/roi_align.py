@@ -45,6 +45,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from mx_rcnn_tpu.ops.roi_align import fpn_level_assignment
 
+# Default roi window in feature cells — the single knob every entry point
+# below defaults to.  MUST stay 10 above ops.roi_align.MAX_EXTENT_CELLS so
+# the XLA and Pallas paths assign rois to identical levels (see there);
+# detection/graph.py threads this SAME constant into both the single-chip
+# and shard_map'd call sites so the two can never silently diverge.
+POOL_WINDOW = 48
+
 
 def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
     """Rows = P = num_bins*sr sample coords; cols = T window cells.
@@ -74,8 +81,52 @@ def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
     return w * inside.astype(jnp.float32)                    # (P, T)
 
 
+def _dot_split_weights(w, x, dims, emulate=False):
+    """``w @ x`` with f32 weights against a NATIVE-bf16 operand in two MXU
+    passes: w = w_hi + w_lo (each bf16) and the products accumulate in f32,
+    so the only error is the 2^-16-level tail of the weight split — versus
+    SIX passes for an all-f32 HIGHEST dot.  Exact enough for interpolation
+    weights (sample positions quantize at ~2^-16, far below bilinear's own
+    bf16-feature granularity).
+
+    ``emulate`` (interpret mode off-TPU): XLA:CPU lacks a bf16 x bf16 = f32
+    dot, so each pass runs as an f32 dot of the SAME bf16-valued operands —
+    bf16 products are exact in f32, making the emulation numerically
+    identical to the MXU pass."""
+    w_hi = w.astype(jnp.bfloat16)
+    w_lo = (w - w_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    kw = dict(
+        dimension_numbers=dims, preferred_element_type=jnp.float32,
+    )
+    if emulate:
+        w_hi, w_lo = w_hi.astype(jnp.float32), w_lo.astype(jnp.float32)
+        x = x.astype(jnp.float32)
+    return jax.lax.dot_general(w_hi, x, **kw) + jax.lax.dot_general(w_lo, x, **kw)
+
+
+def _dot_f32_3pass(a, b, dims, emulate=False):
+    """f32 @ f32 to ~2^-16 in THREE bf16 MXU passes (hi*hi + hi*lo +
+    lo*hi; the lo*lo term is below 2^-32).  Mosaic rejects
+    ``Precision.HIGH``, so the classic split is written out; HIGHEST (six
+    passes) costs 2x this for precision the bf16-sourced operands here
+    cannot use.  ``emulate`` as in :func:`_dot_split_weights`."""
+    a_hi = a.astype(jnp.bfloat16)
+    a_lo = (a - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    b_hi = b.astype(jnp.bfloat16)
+    b_lo = (b - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    if emulate:
+        a_hi, a_lo = a_hi.astype(jnp.float32), a_lo.astype(jnp.float32)
+        b_hi, b_lo = b_hi.astype(jnp.float32), b_lo.astype(jnp.float32)
+    kw = dict(dimension_numbers=dims, preferred_element_type=jnp.float32)
+    return (
+        jax.lax.dot_general(a_hi, b_hi, **kw)
+        + jax.lax.dot_general(a_hi, b_lo, **kw)
+        + jax.lax.dot_general(a_lo, b_hi, **kw)
+    )
+
+
 def _kernel(
-    roi_ref,       # SMEM block (1, 1, 10) f32, one roi per grid step:
+    roi_ref,       # SMEM block (G, 1, 10) f32, G rois per grid step:
                    # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch]
                    # Streamed per step, NOT scalar-prefetched: a prefetch
                    # table costs ~512 B of smem PER ROW, so an N = B*R
@@ -86,92 +137,115 @@ def _kernel(
     t: int,
     output_size: int,
     sampling_ratio: int,
+    group: int,
+    interpret: bool,
 ):
     feat_refs = rest[:num_levels]
     out_ref = rest[num_levels]
-    win = rest[num_levels + 1]
-    sem = rest[num_levels + 2]
+    win = rest[num_levels + 1]     # (G, T, T, C) VMEM scratch
+    sem = rest[num_levels + 2]     # DMA sems, shape (G,)
 
-    level = roi_ref[0, 0, 6].astype(jnp.int32)
-    oy = roi_ref[0, 0, 7].astype(jnp.int32)
-    ox = pl.multiple_of(roi_ref[0, 0, 8].astype(jnp.int32), 8)
-    bi = roi_ref[0, 0, 9].astype(jnp.int32)
+    # Phase 1: start ALL G window DMAs, then wait — the copies fly
+    # concurrently, amortizing HBM latency across the group (a 1-roi-per-
+    # step grid serializes fetch->compute->fetch and measured ~10 ms for
+    # 1024 train rois; grouped fetches overlap).
+    for g in range(group):
+        level = roi_ref[g, 0, 6].astype(jnp.int32)
+        for i, f in enumerate(feat_refs):
+            th = min(t, f.shape[1])
+            tw = min(t, f.shape[2])
+            if th < t or tw < t:
+                @pl.when(level == i)
+                def _(g=g, th=th, tw=tw):
+                    win[g] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
 
-    # Window DMA from the assigned level of the roi's image.  The whole
-    # batch rides ONE grid (N = B*R steps) — batching is a meta column, not
-    # a python loop of pallas_calls.  Maps smaller than T copy their full
-    # extent into the top-left corner of the (zeroed) window.
-    for i, f in enumerate(feat_refs):
-        th = min(t, f.shape[1])
-        tw = min(t, f.shape[2])
-        if th < t or tw < t:
+    for g in range(group):
+        level = roi_ref[g, 0, 6].astype(jnp.int32)
+        oy = roi_ref[g, 0, 7].astype(jnp.int32)
+        ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
+        bi = roi_ref[g, 0, 9].astype(jnp.int32)
+        for i, f in enumerate(feat_refs):
+            th = min(t, f.shape[1])
+            tw = min(t, f.shape[2])
+
             @pl.when(level == i)
-            def _():
-                win[:, :, :] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
+            def _(f=f, th=th, tw=tw, g=g, oy=oy, ox=ox, bi=bi):
+                pltpu.make_async_copy(
+                    f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                    win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
+                    sem.at[g],
+                ).start()
 
-        @pl.when(level == i)
-        def _(f=f, th=th, tw=tw):
-            dma = pltpu.make_async_copy(
-                f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                win.at[pl.ds(0, th), pl.ds(0, tw), :],
-                sem,
-            )
-            dma.start()
-            dma.wait()
+    for g in range(group):
+        level = roi_ref[g, 0, 6].astype(jnp.int32)
+        oy = roi_ref[g, 0, 7].astype(jnp.int32)
+        ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
+        bi = roi_ref[g, 0, 9].astype(jnp.int32)
+        for i, f in enumerate(feat_refs):
+            th = min(t, f.shape[1])
+            tw = min(t, f.shape[2])
 
-    x1 = roi_ref[0, 0, 0]
-    y1 = roi_ref[0, 0, 1]
-    bin_w = roi_ref[0, 0, 2]
-    bin_h = roi_ref[0, 0, 3]
-    hl = roi_ref[0, 0, 4]
-    wl = roi_ref[0, 0, 5]
+            @pl.when(level == i)
+            def _(f=f, th=th, tw=tw, g=g, oy=oy, ox=ox, bi=bi):
+                pltpu.make_async_copy(
+                    f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                    win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
+                    sem.at[g],
+                ).wait()
 
+    # Phase 2: interpolate each roi's window (two small matmuls each).
     s, sr = output_size, sampling_ratio
-    wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
-    wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)          # (Q=P, T)
-
     c = win.shape[-1]
-    window = win[:, :, :].astype(jnp.float32)
-    # rows: (P, T) @ (T, T*C) -> (P, T, C)
-    # HIGHEST precision: the interpolation weights are exact f32; default
-    # (bf16 MXU passes) would quantize sample positions by ~2^-8.
-    rows = jax.lax.dot_general(
-        wy, window.reshape(t, t * c),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    ).reshape(s * sr, t, c)
-    # cols: contract the T (x) axis -> (Q, P, C)
-    qpc = jax.lax.dot_general(
-        wx, rows,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    # bin-average both sample axes, then swap (x-bins, y-bins) -> (y, x).
-    pooled = qpc.reshape(s, sr, s, sr, c).mean(axis=(1, 3))   # (Sx, Sy, C)
-    out_ref[0] = jnp.swapaxes(pooled, 0, 1).astype(out_ref.dtype)
+    for g in range(group):
+        x1 = roi_ref[g, 0, 0]
+        y1 = roi_ref[g, 0, 1]
+        bin_w = roi_ref[g, 0, 2]
+        bin_h = roi_ref[g, 0, 3]
+        hl = roi_ref[g, 0, 4]
+        wl = roi_ref[g, 0, 5]
+        oy = roi_ref[g, 0, 7].astype(jnp.int32)
+        ox = roi_ref[g, 0, 8].astype(jnp.int32)
+
+        wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
+        wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)          # (Q=P, T)
+
+        # rows: (P, T) @ (T, T*C) -> (P, T, C) — the BIG matmul (N = T*C)
+        # contracts directly against the native-dtype window: bf16 windows
+        # take the 2-pass split-weight path (see _dot_split_weights); f32
+        # windows (tiny CPU-recipe configs) keep the exact HIGHEST dot.
+        dims_rows = (((1,), (0,)), ((), ()))
+        dims_cols = (((1,), (1,)), ((), ()))
+        if win.dtype == jnp.bfloat16:
+            rows = _dot_split_weights(
+                wy, win[g].reshape(t, t * c), dims_rows, emulate=interpret
+            ).reshape(s * sr, t, c)
+            # cols: f32 intermediate, 3-pass split -> (Q, P, C)
+            qpc = _dot_f32_3pass(wx, rows, dims_cols, emulate=interpret)
+        else:
+            rows = jax.lax.dot_general(
+                wy, win[g].astype(jnp.float32).reshape(t, t * c),
+                dimension_numbers=dims_rows,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).reshape(s * sr, t, c)
+            qpc = jax.lax.dot_general(
+                wx, rows,
+                dimension_numbers=dims_cols,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        # bin-average both sample axes, swap (x-bins, y-bins) -> (y, x).
+        pooled = qpc.reshape(s, sr, s, sr, c).mean(axis=(1, 3))   # (Sx, Sy, C)
+        out_ref[g] = jnp.swapaxes(pooled, 0, 1).astype(out_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("output_size", "sampling_ratio", "window", "interpret")
-)
-def multilevel_roi_align_pallas(
-    feature_pyramid: dict[int, jnp.ndarray],
-    rois: jnp.ndarray,
-    output_size: int = 7,
-    sampling_ratio: int = 2,
-    window: int = 48,
-    interpret: bool = False,
-) -> jnp.ndarray:
-    """Drop-in replacement for :func:`multilevel_roi_align`.
+def _prep(feature_pyramid, rois, output_size, window):
+    """Shared forward/backward preprocessing: pad level widths to the
+    Mosaic sublane multiple and build the per-roi parameter table.
 
-    Accepts the per-image contract — pyramid {level: (H_l, W_l, C)},
-    rois (R, 4) → (R, S, S, C) — or the batched one: {level: (B, H_l, W_l,
-    C)}, rois (B, R, 4) → (B, R, S, S, C).  The batch folds into the
-    kernel grid (one step per roi across ALL images, B*R total), so a
-    batched call is ONE pallas_call, not B.
-    """
+    Returns (levels, feats (padded, batched), ws_true, roi_params, b,
+    r_per, batched).  Forward and backward MUST agree on every field here
+    (level assignment, window origins), so it is factored out."""
     levels = sorted(feature_pyramid.keys())
     batched = rois.ndim == 3
     if not batched:
@@ -180,8 +254,6 @@ def multilevel_roi_align_pallas(
     feats = [feature_pyramid[l] for l in levels]
     b, r_per = rois.shape[:2]
     flat = rois.reshape(-1, 4)
-    n = flat.shape[0]
-    c = feats[0].shape[-1]
     t = window
     # Mosaic's HBM window slice needs the sublane (W) dim to be a multiple
     # of 8; recipe canvases (800x1344) give odd widths at coarse levels
@@ -217,7 +289,7 @@ def multilevel_roi_align_pallas(
     # Window origin: one cell of bilinear margin, clamped into the map.
     # ox additionally floors to a multiple of 8 — Mosaic requires provable
     # sublane alignment for HBM slices in the tiled (second-to-last) dim;
-    # the up-to-7-cell loss is budgeted in max_extent_cells below.
+    # the up-to-7-cell loss is budgeted in max_extent_cells above.
     oy = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - t, 0)).astype(jnp.int32)
     ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - t, 0)).astype(jnp.int32)
     ox = (ox // 8) * 8
@@ -232,6 +304,51 @@ def multilevel_roi_align_pallas(
     ).astype(jnp.float32)[:, None, :]                          # (N, 1, 10)
     # 3-D so the SMEM block's last two dims equal the array's (Mosaic's
     # block-shape divisibility rule exempts full-extent dims).
+    return levels, feats, ws_true, roi_params, b, r_per, batched
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("output_size", "sampling_ratio", "window", "interpret", "group"),
+)
+def multilevel_roi_align_pallas(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+    window: int = POOL_WINDOW,
+    interpret: bool = False,
+    group: int = 8,
+) -> jnp.ndarray:
+    """Drop-in replacement for :func:`multilevel_roi_align`.
+
+    Accepts the per-image contract — pyramid {level: (H_l, W_l, C)},
+    rois (R, 4) → (R, S, S, C) — or the batched one: {level: (B, H_l, W_l,
+    C)}, rois (B, R, 4) → (B, R, S, S, C).  The batch folds into the
+    kernel grid (one step per ``group`` rois across ALL images), so a
+    batched call is ONE pallas_call, not B.  ``group`` rois per step issue
+    their window DMAs together (concurrent fetches — measured ~3x over the
+    1-roi-per-step grid at train shapes); the roi count is padded to a
+    multiple of ``group`` with row-0 copies whose outputs are sliced off.
+    """
+    levels, feats, ws_true, roi_params, b, r_per, batched = _prep(
+        feature_pyramid, rois, output_size, window
+    )
+    n = b * r_per
+    c = feats[0].shape[-1]
+    t = window
+    # The (G, T, T, C) window scratch must fit scoped VMEM (16 MB budget,
+    # shared with the out block): G=8 bf16 windows at T=48/C=256 are
+    # 9.4 MB, but an f32 pyramid (the tiny CPU-recipe configs) doubles
+    # that past the limit — shrink the group to fit ~12 MB of scratch.
+    itemsize = jnp.dtype(feats[0].dtype).itemsize
+    budget = max(1, (12 * 1024 * 1024) // (t * t * c * itemsize))
+    grp = max(1, min(group, budget, n))
+    n_pad = -n % grp
+    if n_pad:
+        roi_params = jnp.concatenate(
+            [roi_params, jnp.broadcast_to(roi_params[:1], (n_pad, 1, 10))]
+        )
 
     kernel = functools.partial(
         _kernel,
@@ -239,34 +356,218 @@ def multilevel_roi_align_pallas(
         t=t,
         output_size=output_size,
         sampling_ratio=sampling_ratio,
+        group=grp,
+        interpret=interpret,
     )
     out = pl.pallas_call(
+        kernel,
+        grid=((n + n_pad) // grp,),
+        in_specs=[
+            pl.BlockSpec(
+                (grp, 1, 10), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+            )
+        ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
+        out_specs=pl.BlockSpec(
+            (grp, output_size, output_size, c),
+            lambda r: (r, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((grp, t, t, c), feats[0].dtype),
+            pltpu.SemaphoreType.DMA((grp,)),
+        ],
+        out_shape=jax.ShapeDtypeStruct(
+            (n + n_pad, output_size, output_size, c), feats[0].dtype
+        ),
+        interpret=interpret,
+    )(roi_params, *feats)
+    out = out[:n].reshape(b, r_per, output_size, output_size, c)
+    return out if batched else out[0]
+
+
+def _bwd_kernel(
+    roi_ref,       # SMEM (1, 1, 10) f32 — same 10 fields as the forward.
+    g_ref,         # VMEM (1, S, S, C) — cotangent of this roi's pooled out.
+    *rest,
+    num_levels: int,
+    t: int,
+    output_size: int,
+    sampling_ratio: int,
+    interpret: bool,
+):
+    """Transpose of :func:`_kernel`, accumulated by read-modify-write.
+
+    The forward is two interpolation matmuls of a DMA'd window; its exact
+    transpose is two transposed matmuls producing a (T, T, C) window
+    gradient, ADDED into the roi's window slice of its level's gradient
+    buffer.  The XLA autodiff of the gather formulation instead emits an
+    HBM scatter-add with ~16 duplicate-index rows per bin, which the TPU
+    serializes — measured 18-19 ms/step at train shapes (b2 x 512 rois,
+    R101-FPN) vs ~3 ms for this kernel.
+
+    Correctness of the accumulation: the TPU grid is sequential on a core,
+    and each step's read-DMA waits before the add and the write-DMA waits
+    before the step ends, so overlapping windows of different rois
+    serialize cleanly (no lost updates).  The buffers accumulate in f32 —
+    strictly tighter than the XLA path's feature-dtype (bf16 in the train
+    graph) scatter accumulation.
+    """
+    # rest: [grad_level ANY ×L (in, aliased)] + [grad_level ANY ×L (out)] +
+    # scratch [win2 (T,T,C) f32 VMEM, sem].  The aliased inputs are not
+    # read through their input refs — RMW goes through the OUTPUT refs,
+    # which point at the same buffers.
+    out_refs = rest[num_levels: 2 * num_levels]
+    win2 = rest[2 * num_levels]
+    sem = rest[2 * num_levels + 1]
+
+    level = roi_ref[0, 0, 6].astype(jnp.int32)
+    oy = roi_ref[0, 0, 7].astype(jnp.int32)
+    ox = pl.multiple_of(roi_ref[0, 0, 8].astype(jnp.int32), 8)
+    bi = roi_ref[0, 0, 9].astype(jnp.int32)
+    x1 = roi_ref[0, 0, 0]
+    y1 = roi_ref[0, 0, 1]
+    bin_w = roi_ref[0, 0, 2]
+    bin_h = roi_ref[0, 0, 3]
+    hl = roi_ref[0, 0, 4]
+    wl = roi_ref[0, 0, 5]
+
+    s, sr = output_size, sampling_ratio
+    wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)           # (P, T)
+    wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)           # (Q, T)
+
+    c = win2.shape[-1]
+    # d_out (S_y, S_x, C) -> d_qpc (Q, P, C): transpose of
+    # "mean over sr x sr subsamples, then (x, y) -> (y, x) swap".  Stays in
+    # the cotangent's NATIVE dtype (bf16 in the train graph): /sr^2 is a
+    # power-of-two scale (exact), so the small matmul below can contract
+    # against it with 2-pass split weights.
+    g = g_ref[0]                                               # (S, S, C)
+    d_pooled = jnp.swapaxes(g, 0, 1) / jnp.asarray(sr * sr, g.dtype)
+    d_qpc = jnp.broadcast_to(
+        d_pooled[:, None, :, None, :], (s, sr, s, sr, c)
+    ).reshape(s * sr, s * sr, c)                               # (Q, P, C)
+
+    # d_rows_T[tx, p, c] = sum_q wx[q, tx] * d_qpc[q, p, c] — the SMALL
+    # matmul (N = P*C), against the native cotangent.
+    dims_rows = (((0,), (0,)), ((), ()))
+    dims_win = (((0,), (1,)), ((), ()))
+    if d_qpc.dtype == jnp.bfloat16:
+        d_rows_t = _dot_split_weights(
+            wx, d_qpc.reshape(s * sr, s * sr * c), dims_rows,
+            emulate=interpret,
+        ).reshape(t, s * sr, c)
+        # d_window: the BIG matmul (N = T*C) over the f32 intermediate,
+        # 3-pass split.
+        d_window = _dot_f32_3pass(
+            wy, d_rows_t, dims_win, emulate=interpret
+        )                                                      # (Ty, Tx, C)
+    else:
+        d_rows_t = jax.lax.dot_general(
+            wx, d_qpc.astype(jnp.float32).reshape(s * sr, s * sr * c),
+            dimension_numbers=dims_rows,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(t, s * sr, c)                                # (Tx, P, C)
+        d_window = jax.lax.dot_general(
+            wy, d_rows_t,
+            dimension_numbers=dims_win,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                      # (Ty, Tx, C)
+
+    for i, gl in enumerate(out_refs):
+        th = min(t, gl.shape[1])
+        tw = min(t, gl.shape[2])
+
+        @pl.when(level == i)
+        def _(gl=gl, th=th, tw=tw):
+            # Read-modify-write of the roi's window slice.  Taps beyond the
+            # level's true extent carry zero weight (the interp matrices
+            # mask by extent), so adding the [:th, :tw] corner is exact.
+            rd = pltpu.make_async_copy(
+                gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                sem,
+            )
+            rd.start()
+            rd.wait()
+            win2[:th, :tw, :] = win2[:th, :tw, :] + d_window[:th, :tw, :]
+            wr = pltpu.make_async_copy(
+                win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                sem,
+            )
+            wr.start()
+            wr.wait()
+
+
+@functools.partial(
+    jax.jit, static_argnames=("output_size", "sampling_ratio", "window", "interpret")
+)
+def multilevel_roi_align_bwd_pallas(
+    feature_pyramid: dict[int, jnp.ndarray],
+    rois: jnp.ndarray,
+    g: jnp.ndarray,
+    output_size: int = 7,
+    sampling_ratio: int = 2,
+    window: int = POOL_WINDOW,
+    interpret: bool = False,
+) -> dict[int, jnp.ndarray]:
+    """Feature-pyramid gradient of :func:`multilevel_roi_align_pallas`.
+
+    ``g``: cotangent of the pooled output — (R, S, S, C) or batched
+    (B, R, S, S, C).  Returns a pyramid-shaped dict of gradients in the
+    features' dtype.  Accumulation is f32 via per-roi window RMW
+    (see :func:`_bwd_kernel`)."""
+    levels, feats, ws_true, roi_params, b, r_per, batched = _prep(
+        feature_pyramid, rois, output_size, window
+    )
+    n = b * r_per
+    c = feats[0].shape[-1]
+    t = window
+    s = output_size
+    g2 = g.reshape(n, s, s, c)
+    zeros = [jnp.zeros(f.shape, jnp.float32) for f in feats]
+
+    kernel = functools.partial(
+        _bwd_kernel,
+        num_levels=len(levels),
+        t=t,
+        output_size=s,
+        sampling_ratio=sampling_ratio,
+        interpret=interpret,
+    )
+    grads = pl.pallas_call(
         kernel,
         grid=(n,),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, 10), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
-            )
+            ),
+            pl.BlockSpec(
+                (1, s, s, c), lambda r: (r, 0, 0, 0), memory_space=pltpu.VMEM
+            ),
         ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
-        out_specs=pl.BlockSpec(
-            (1, output_size, output_size, c),
-            lambda r: (r, 0, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
         scratch_shapes=[
-            pltpu.VMEM((t, t, c), feats[0].dtype),
+            pltpu.VMEM((t, t, c), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
         ],
-        out_shape=jax.ShapeDtypeStruct(
-            (n, output_size, output_size, c), feats[0].dtype
-        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(f.shape, jnp.float32) for f in feats
+        ],
+        input_output_aliases={2 + i: i for i in range(len(levels))},
         interpret=interpret,
-    )(roi_params, *feats)
-    out = out.reshape(b, r_per, output_size, output_size, c)
-    return out if batched else out[0]
+    )(roi_params, g2, *zeros)
+
+    out = {}
+    for i, l in enumerate(levels):
+        gl = grads[i][:, :, : ws_true[i], :].astype(feature_pyramid[l].dtype)
+        out[l] = gl if batched else gl[0]
+    return out
 
 
-def pallas_supported(feature_pyramid: dict, window: int = 48) -> bool:
+def pallas_supported(feature_pyramid: dict, window: int = POOL_WINDOW) -> bool:
     """Static check that every level's layout is Mosaic-DMA-sliceable:
     channels must be a multiple of 128 (lane dim).  The x (sublane-tiled)
     dim, which the window copy slices, is zero-padded to a multiple of 8
@@ -287,7 +588,7 @@ def multilevel_roi_align_fast(
     rois: jnp.ndarray,
     output_size: int = 7,
     sampling_ratio: int = 2,
-    window: int = 48,
+    window: int = POOL_WINDOW,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas forward + XLA-reference backward.
@@ -313,9 +614,22 @@ def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window, interp
 
 
 def _fast_bwd(output_size, sampling_ratio, window, interpret, res, g):
-    from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
+    import os
 
     feature_pyramid, rois = res
+
+    # Pallas window-RMW backward by default (the XLA autodiff backward is
+    # a duplicate-index HBM scatter-add the TPU serializes: 18-19 ms/step
+    # at R101-FPN train shapes vs ~3 ms for the kernel — see _bwd_kernel).
+    # MX_RCNN_POOL_BWD=xla restores the old path for A/B and debugging.
+    if os.environ.get("MX_RCNN_POOL_BWD", "pallas") != "xla":
+        grad_pyramid = multilevel_roi_align_bwd_pallas(
+            feature_pyramid, rois, g, output_size=output_size,
+            sampling_ratio=sampling_ratio, window=window, interpret=interpret,
+        )
+        return grad_pyramid, jnp.zeros_like(rois)
+
+    from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
 
     def ref(p, rr):
         return multilevel_roi_align(
@@ -342,7 +656,7 @@ def sharded_multilevel_roi_align(
     sampling_ratio: int,
     mesh,
     data_axis: str,
-    window: int = 48,
+    window: int = POOL_WINDOW,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """The kernel's multi-chip form: :func:`multilevel_roi_align_fast`
@@ -356,8 +670,9 @@ def sharded_multilevel_roi_align(
     bare pallas_call under a sharded jit would get.  Axes other than
     ``data_axis`` stay under GSPMD (partial-manual shard_map).
     ``check_vma=False``: the pallas out_shape carries no varying-mesh-axes
-    annotation.  The custom_vjp rides inside, so the backward (the XLA
-    reference) is per-shard too."""
+    annotation.  The custom_vjp rides inside, so the backward (the Pallas
+    window-RMW kernel by default since r3; autodiff-of-XLA under
+    MX_RCNN_POOL_BWD=xla) is per-shard too."""
     from jax.sharding import PartitionSpec as P
 
     # Positional call: custom_vjp nondiff_argnums forbid keywords.

