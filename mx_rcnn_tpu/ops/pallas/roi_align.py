@@ -81,50 +81,6 @@ def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
     return w * inside.astype(jnp.float32)                    # (P, T)
 
 
-def _dot_split_weights(w, x, dims, emulate=False):
-    """``w @ x`` with f32 weights against a NATIVE-bf16 operand in two MXU
-    passes: w = w_hi + w_lo (each bf16) and the products accumulate in f32,
-    so the only error is the 2^-16-level tail of the weight split — versus
-    SIX passes for an all-f32 HIGHEST dot.  Exact enough for interpolation
-    weights (sample positions quantize at ~2^-16, far below bilinear's own
-    bf16-feature granularity).
-
-    ``emulate`` (interpret mode off-TPU): XLA:CPU lacks a bf16 x bf16 = f32
-    dot, so each pass runs as an f32 dot of the SAME bf16-valued operands —
-    bf16 products are exact in f32, making the emulation numerically
-    identical to the MXU pass."""
-    w_hi = w.astype(jnp.bfloat16)
-    w_lo = (w - w_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    kw = dict(
-        dimension_numbers=dims, preferred_element_type=jnp.float32,
-    )
-    if emulate:
-        w_hi, w_lo = w_hi.astype(jnp.float32), w_lo.astype(jnp.float32)
-        x = x.astype(jnp.float32)
-    return jax.lax.dot_general(w_hi, x, **kw) + jax.lax.dot_general(w_lo, x, **kw)
-
-
-def _dot_f32_3pass(a, b, dims, emulate=False):
-    """f32 @ f32 to ~2^-16 in THREE bf16 MXU passes (hi*hi + hi*lo +
-    lo*hi; the lo*lo term is below 2^-32).  Mosaic rejects
-    ``Precision.HIGH``, so the classic split is written out; HIGHEST (six
-    passes) costs 2x this for precision the bf16-sourced operands here
-    cannot use.  ``emulate`` as in :func:`_dot_split_weights`."""
-    a_hi = a.astype(jnp.bfloat16)
-    a_lo = (a - a_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    b_hi = b.astype(jnp.bfloat16)
-    b_lo = (b - b_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    if emulate:
-        a_hi, a_lo = a_hi.astype(jnp.float32), a_lo.astype(jnp.float32)
-        b_hi, b_lo = b_hi.astype(jnp.float32), b_lo.astype(jnp.float32)
-    kw = dict(dimension_numbers=dims, preferred_element_type=jnp.float32)
-    return (
-        jax.lax.dot_general(a_hi, b_hi, **kw)
-        + jax.lax.dot_general(a_hi, b_lo, **kw)
-        + jax.lax.dot_general(a_lo, b_hi, **kw)
-    )
-
-
 def _kernel(
     roi_ref,       # SMEM block (G, 1, 10) f32, G rois per grid step:
                    # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch]
@@ -138,7 +94,6 @@ def _kernel(
     output_size: int,
     sampling_ratio: int,
     group: int,
-    interpret: bool,
 ):
     feat_refs = rest[:num_levels]
     out_ref = rest[num_levels]
@@ -209,31 +164,25 @@ def _kernel(
         wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
         wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)          # (Q=P, T)
 
-        # rows: (P, T) @ (T, T*C) -> (P, T, C) — the BIG matmul (N = T*C)
-        # contracts directly against the native-dtype window: bf16 windows
-        # take the 2-pass split-weight path (see _dot_split_weights); f32
-        # windows (tiny CPU-recipe configs) keep the exact HIGHEST dot.
-        dims_rows = (((1,), (0,)), ((), ()))
-        dims_cols = (((1,), (1,)), ((), ()))
-        if win.dtype == jnp.bfloat16:
-            rows = _dot_split_weights(
-                wy, win[g].reshape(t, t * c), dims_rows, emulate=interpret
-            ).reshape(s * sr, t, c)
-            # cols: f32 intermediate, 3-pass split -> (Q, P, C)
-            qpc = _dot_f32_3pass(wx, rows, dims_cols, emulate=interpret)
-        else:
-            rows = jax.lax.dot_general(
-                wy, win[g].astype(jnp.float32).reshape(t, t * c),
-                dimension_numbers=dims_rows,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ).reshape(s * sr, t, c)
-            qpc = jax.lax.dot_general(
-                wx, rows,
-                dimension_numbers=dims_cols,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
+        # rows: (P, T) @ (T, T*C) -> (P, T, C).
+        # HIGHEST precision: the interpolation weights are exact f32;
+        # default (bf16 MXU passes) would quantize sample positions ~2^-8.
+        # A 2-pass split-weight variant was tried in r3 and REVERTED: with
+        # M = S*sr = 14 against the MXU's 128 rows the matmuls are
+        # padding-bound, not pass-bound — the split's extra per-step casts
+        # made the forward ~2 ms SLOWER at train shapes (9.4 -> 11.6 ms).
+        rows = jax.lax.dot_general(
+            wy, win[g].astype(jnp.float32).reshape(t, t * c),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(s * sr, t, c)
+        qpc = jax.lax.dot_general(
+            wx, rows,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
         # bin-average both sample axes, swap (x-bins, y-bins) -> (y, x).
         pooled = qpc.reshape(s, sr, s, sr, c).mean(axis=(1, 3))   # (Sx, Sy, C)
         out_ref[g] = jnp.swapaxes(pooled, 0, 1).astype(out_ref.dtype)
@@ -357,7 +306,6 @@ def multilevel_roi_align_pallas(
         output_size=output_size,
         sampling_ratio=sampling_ratio,
         group=grp,
-        interpret=interpret,
     )
     out = pl.pallas_call(
         kernel,
@@ -393,7 +341,6 @@ def _bwd_kernel(
     t: int,
     output_size: int,
     sampling_ratio: int,
-    interpret: bool,
 ):
     """Transpose of :func:`_kernel`, accumulated by read-modify-write.
 
@@ -449,31 +396,34 @@ def _bwd_kernel(
 
     # d_rows_T[tx, p, c] = sum_q wx[q, tx] * d_qpc[q, p, c] — the SMALL
     # matmul (N = P*C), against the native cotangent.
-    dims_rows = (((0,), (0,)), ((), ()))
-    dims_win = (((0,), (1,)), ((), ()))
-    if d_qpc.dtype == jnp.bfloat16:
-        d_rows_t = _dot_split_weights(
-            wx, d_qpc.reshape(s * sr, s * sr * c), dims_rows,
-            emulate=interpret,
-        ).reshape(t, s * sr, c)
-        # d_window: the BIG matmul (N = T*C) over the f32 intermediate,
-        # 3-pass split.
-        d_window = _dot_f32_3pass(
-            wy, d_rows_t, dims_win, emulate=interpret
-        )                                                      # (Ty, Tx, C)
-    else:
-        d_rows_t = jax.lax.dot_general(
-            wx, d_qpc.astype(jnp.float32).reshape(s * sr, s * sr * c),
-            dimension_numbers=dims_rows,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).reshape(t, s * sr, c)                                # (Tx, P, C)
-        d_window = jax.lax.dot_general(
-            wy, d_rows_t,
-            dimension_numbers=dims_win,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                      # (Ty, Tx, C)
+    # Precision: bf16 cotangents (the train graph) take DEFAULT — one MXU
+    # pass with f32 accumulation.  The operands' information content is
+    # already bf16 (the cotangent arrives in the graph's compute dtype), so
+    # truncating the exact-f32 weights costs ~2^-8 relative — below the
+    # cotangent's own quantization and strictly tighter than the bf16-
+    # accumulating XLA scatter-add this kernel replaced.  Measured 10.7 ->
+    # 6.1 ms at R101 train shapes vs HIGHEST.  f32 cotangents (CPU-recipe
+    # tests, golden paths) keep the exact HIGHEST dot.  The FORWARD stays
+    # HIGHEST always: weight truncation there shifts where features are
+    # SAMPLED (a systematic geometric error, not gradient noise) and its
+    # measured win was only ~1.5 ms.
+    prec = (
+        jax.lax.Precision.DEFAULT
+        if g.dtype == jnp.bfloat16
+        else jax.lax.Precision.HIGHEST
+    )
+    d_rows_t = jax.lax.dot_general(
+        wx, d_qpc.astype(jnp.float32).reshape(s * sr, s * sr * c),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    ).reshape(t, s * sr, c)                                    # (Tx, P, C)
+    d_window = jax.lax.dot_general(
+        wy, d_rows_t,
+        dimension_numbers=(((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )                                                          # (Ty, Tx, C)
 
     for i, gl in enumerate(out_refs):
         th = min(t, gl.shape[1])
@@ -535,7 +485,6 @@ def multilevel_roi_align_bwd_pallas(
         t=t,
         output_size=s,
         sampling_ratio=sampling_ratio,
-        interpret=interpret,
     )
     grads = pl.pallas_call(
         kernel,
