@@ -399,14 +399,21 @@ def _bwd_kernel(
     # Precision: bf16 cotangents (the train graph) take DEFAULT — one MXU
     # pass with f32 accumulation.  The operands' information content is
     # already bf16 (the cotangent arrives in the graph's compute dtype), so
-    # truncating the exact-f32 weights costs ~2^-8 relative — below the
-    # cotangent's own quantization and strictly tighter than the bf16-
-    # accumulating XLA scatter-add this kernel replaced.  Measured 10.7 ->
-    # 6.1 ms at R101 train shapes vs HIGHEST.  f32 cotangents (CPU-recipe
-    # tests, golden paths) keep the exact HIGHEST dot.  The FORWARD stays
-    # HIGHEST always: weight truncation there shifts where features are
-    # SAMPLED (a systematic geometric error, not gradient noise) and its
-    # measured win was only ~1.5 ms.
+    # truncating the exact-f32 weights costs ~2^-8 relative.  The SECOND
+    # dot additionally truncates the f32 intermediate d_rows_t: each of its
+    # rows is a <=2-tap combination (weights summing <=1) of bf16-valued
+    # cotangent entries, so that rounding is one more independent ~2^-8
+    # relative error — no amplification, still below the cotangent's own
+    # quantization and strictly tighter than the bf16-ACCUMULATING XLA
+    # scatter-add this kernel replaced (hundreds of bf16 += per P2 cell).
+    # On-chip check (the off-TPU interpret tests can't see MXU truncation):
+    # max |pallas - xla-autodiff| feature-grad diff at R101 train shapes is
+    # within bf16 output granularity.  Measured 10.7 -> 6.1 ms at R101
+    # train shapes vs HIGHEST.  f32 cotangents (CPU-recipe tests, golden
+    # paths) keep the exact HIGHEST dot.  The FORWARD stays HIGHEST always:
+    # weight truncation there shifts where features are SAMPLED (a
+    # systematic geometric error, not gradient noise) and its measured win
+    # was only ~1.5 ms.
     prec = (
         jax.lax.Precision.DEFAULT
         if g.dtype == jnp.bfloat16
