@@ -81,6 +81,20 @@ def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
     return w * inside.astype(jnp.float32)                    # (P, T)
 
 
+def _interp_matrix_avg(start, bin_size, num_bins, sr, extent, origin, t):
+    """(S, T) interpolation matrix with the sr-subsample bin mean BAKED IN.
+
+    Row i = (1/sr) * sum of the sr bilinear-tap rows of bin i, i.e. the
+    mean over subsamples folded into the weights (mean of linear maps =
+    linear map).  Halving the matmul row count this way took the kernel's
+    x-interpolation matmul — measured as its LARGEST compute component at
+    eval shapes (N = P*C with P = S*sr) — down by 2x with no semantics
+    change beyond f32 summation order (weights are computed in f32; /sr is
+    exact for the power-of-two default)."""
+    w = _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t)
+    return w.reshape(num_bins, sr, t).sum(axis=1) / sr       # (S, T)
+
+
 def _kernel(
     roi_ref,       # SMEM block (G, 1, 10) f32, G rois per grid step:
                    # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch]
@@ -148,7 +162,10 @@ def _kernel(
                     sem.at[g],
                 ).wait()
 
-    # Phase 2: interpolate each roi's window (two small matmuls each).
+    # Phase 2: interpolate each roi's window (two small matmuls each, with
+    # the sr x sr bin mean baked into the interpolation matrices — see
+    # _interp_matrix_avg; the explicit post-matmul mean doubled the second
+    # matmul's N for nothing).
     s, sr = output_size, sampling_ratio
     c = win.shape[-1]
     for g in range(group):
@@ -161,31 +178,29 @@ def _kernel(
         oy = roi_ref[g, 0, 7].astype(jnp.int32)
         ox = roi_ref[g, 0, 8].astype(jnp.int32)
 
-        wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)          # (P, T)
-        wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)          # (Q=P, T)
+        wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)      # (S, T)
+        wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox, t)      # (S, T)
 
-        # rows: (P, T) @ (T, T*C) -> (P, T, C).
+        # rows: (S, T) @ (T, T*C) -> (S, T, C).
         # HIGHEST precision: the interpolation weights are exact f32;
         # default (bf16 MXU passes) would quantize sample positions ~2^-8.
         # A 2-pass split-weight variant was tried in r3 and REVERTED: with
-        # M = S*sr = 14 against the MXU's 128 rows the matmuls are
-        # padding-bound, not pass-bound — the split's extra per-step casts
-        # made the forward ~2 ms SLOWER at train shapes (9.4 -> 11.6 ms).
+        # single-tile M the matmuls are padding-bound, not pass-bound —
+        # the split's extra per-step casts made the forward ~2 ms SLOWER
+        # at train shapes (9.4 -> 11.6 ms).
         rows = jax.lax.dot_general(
             wy, win[g].astype(jnp.float32).reshape(t, t * c),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ).reshape(s * sr, t, c)
+        ).reshape(s, t, c)
         qpc = jax.lax.dot_general(
             wx, rows,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        )
-        # bin-average both sample axes, swap (x-bins, y-bins) -> (y, x).
-        pooled = qpc.reshape(s, sr, s, sr, c).mean(axis=(1, 3))   # (Sx, Sy, C)
-        out_ref[g] = jnp.swapaxes(pooled, 0, 1).astype(out_ref.dtype)
+        )                                                         # (Sx, Sy, C)
+        out_ref[g] = jnp.swapaxes(qpc, 0, 1).astype(out_ref.dtype)
 
 
 def _prep(feature_pyramid, rois, output_size, window):
@@ -379,23 +394,21 @@ def _bwd_kernel(
     wl = roi_ref[0, 0, 5]
 
     s, sr = output_size, sampling_ratio
-    wy = _interp_matrix(y1, bin_h, s, sr, hl, oy, t)           # (P, T)
-    wx = _interp_matrix(x1, bin_w, s, sr, wl, ox, t)           # (Q, T)
+    wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)       # (S, T)
+    wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox, t)       # (S, T)
 
     c = win2.shape[-1]
-    # d_out (S_y, S_x, C) -> d_qpc (Q, P, C): transpose of
-    # "mean over sr x sr subsamples, then (x, y) -> (y, x) swap".  Stays in
-    # the cotangent's NATIVE dtype (bf16 in the train graph): /sr^2 is a
-    # power-of-two scale (exact), so the small matmul below can contract
-    # against it with 2-pass split weights.
+    # d_out (S_y, S_x, C) -> d_qpc (S_x, S_y, C): just the transpose of the
+    # forward's (x, y) -> (y, x) swap — the sr x sr subsample mean lives in
+    # the averaged interpolation matrices (forward and backward MUST use
+    # the same baked form; _interp_matrix_avg), so the old /sr^2 scale and
+    # subsample broadcast are gone.  Stays in the cotangent's NATIVE dtype
+    # (bf16 in the train graph).
     g = g_ref[0]                                               # (S, S, C)
-    d_pooled = jnp.swapaxes(g, 0, 1) / jnp.asarray(sr * sr, g.dtype)
-    d_qpc = jnp.broadcast_to(
-        d_pooled[:, None, :, None, :], (s, sr, s, sr, c)
-    ).reshape(s * sr, s * sr, c)                               # (Q, P, C)
+    d_qpc = jnp.swapaxes(g, 0, 1)                              # (S_x, S_y, C)
 
-    # d_rows_T[tx, p, c] = sum_q wx[q, tx] * d_qpc[q, p, c] — the SMALL
-    # matmul (N = P*C), against the native cotangent.
+    # d_rows_T[tx, sy, c] = sum_sx wx[sx, tx] * d_qpc[sx, sy, c] — the
+    # SMALL matmul (N = S*C), against the native cotangent.
     # Precision: bf16 cotangents (the train graph) take DEFAULT — one MXU
     # pass with f32 accumulation.  The operands' information content is
     # already bf16 (the cotangent arrives in the graph's compute dtype), so
@@ -420,11 +433,11 @@ def _bwd_kernel(
         else jax.lax.Precision.HIGHEST
     )
     d_rows_t = jax.lax.dot_general(
-        wx, d_qpc.astype(jnp.float32).reshape(s * sr, s * sr * c),
+        wx, d_qpc.astype(jnp.float32).reshape(s, s * c),
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=prec,
-    ).reshape(t, s * sr, c)                                    # (Tx, P, C)
+    ).reshape(t, s, c)                                         # (Tx, Sy, C)
     d_window = jax.lax.dot_general(
         wy, d_rows_t,
         dimension_numbers=(((0,), (1,)), ((), ())),
