@@ -31,6 +31,12 @@ import time
 import numpy as np
 
 BASELINE_IMG_S_CHIP = 20.0
+# The reference's GPU-era inference speed (~5 fps, Ren et al. / upstream
+# README) — the --eval metric's vs_baseline denominator.  NOTE the two
+# modes' vs_baseline fields are ratios against DIFFERENT anchors: train is
+# "fraction of the >=20 img/s/chip north star", eval is "speedup over the
+# reference's published inference fps".
+BASELINE_EVAL_IMG_S = 5.0
 # v5e peak bf16 matmul throughput, used for the MFU diagnostic.
 V5E_PEAK_BF16_FLOPS = 197e12
 
@@ -42,29 +48,39 @@ def _synthetic_batch(cfg, batch, image_size, k):
     g = cfg.data.max_gt_boxes
     h, w = image_size
     n_gt = 8
-    boxes = np.zeros((batch, g, 4), np.float32)
-    for b in range(batch):
+    # K DISTINCT batches for the scan loop (a single batch broadcast K
+    # times would let every post-warmup step re-read hot pixels/boxes and
+    # slightly flatter cache locality vs real training).
+    n = batch * k
+    boxes = np.zeros((n, g, 4), np.float32)
+    for b in range(n):
         x1 = rng.uniform(0, w - 64, n_gt)
         y1 = rng.uniform(0, h - 64, n_gt)
         bw = rng.uniform(16, 64, n_gt)
         bh = rng.uniform(16, 64, n_gt)
         boxes[b, :n_gt] = np.stack([x1, y1, x1 + bw, y1 + bh], axis=1)
-    classes = np.zeros((batch, g), np.int32)
-    classes[:, :n_gt] = rng.randint(1, cfg.model.num_classes, (batch, n_gt))
-    valid = np.zeros((batch, g), bool)
+    classes = np.zeros((n, g), np.int32)
+    classes[:, :n_gt] = rng.randint(1, cfg.model.num_classes, (n, n_gt))
+    valid = np.zeros((n, g), bool)
     valid[:, :n_gt] = True
+    # Fill per image: one randn(n, h, w, 3) call would transiently hold the
+    # whole stacked batch in float64 (~0.5 GB at k=10) before the cast.
+    images = np.empty((n, h, w, 3), np.float32)
+    for b in range(n):
+        images[b] = rng.randn(h, w, 3)
     data = Batch(
-        images=rng.randn(batch, h, w, 3).astype(np.float32),
-        image_hw=np.full((batch, 2), float(h), np.float32),
+        images=images,
+        image_hw=np.tile(
+            np.asarray([[float(h), float(w)]], np.float32), (n, 1)
+        ),
         gt_boxes=boxes,
         gt_classes=classes,
         gt_valid=valid,
     )
     if k > 1:
-        # Stacked (K, B, ...) batch for the scan loop (same image K times —
-        # the compute path is identical to K distinct batches).
+        # Stacked (K, B, ...) layout consumed by the device-side lax.scan.
         data = Batch(*[
-            None if f is None else np.broadcast_to(f, (k, *f.shape)).copy()
+            None if f is None else f.reshape(k, batch, *f.shape[1:])
             for f in data
         ])
     return data
@@ -153,11 +169,68 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
     return img_s
 
 
+def _eval_bench(cfg, image_size, on_accel):
+    """Inference throughput: forward_inference at test.per_device_batch.
+
+    Timing method: N per-dispatch chained executions (input i+1 = input i +
+    1e-20 * f(output i), all on device) with ONE final fetch — each dispatch
+    provably executes the full forward, nothing can be hoisted.  A
+    scan-with-perturbed-carry form measured 7x slower on the same graph (an
+    XLA scan pathology with a 100 MB changing carry, r3 finding), so eval
+    numbers use the per-dispatch chain; it agrees with the 0-carry scan
+    form to ~3%."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_inference
+    from mx_rcnn_tpu.detection.graph import init_detector
+
+    b = max(cfg.model.test.per_device_batch, 1) if on_accel else 1
+    h, w = image_size
+    model = TwoStageDetector(cfg=cfg.model)
+    variables = init_detector(model, jax.random.PRNGKey(0), (h, w))
+    rng = np.random.RandomState(0)
+    g = cfg.data.max_gt_boxes
+    batch = Batch(
+        images=jnp.asarray(rng.randn(b, h, w, 3), jnp.float32),
+        image_hw=jnp.asarray([[float(h), float(w)]] * b, jnp.float32),
+        gt_boxes=jnp.zeros((b, g, 4), jnp.float32),
+        gt_classes=jnp.zeros((b, g), jnp.int32),
+        gt_valid=jnp.zeros((b, g), bool),
+    )
+
+    def run(imgs):
+        dets = forward_inference(model, variables, batch._replace(images=imgs))
+        return jnp.sum(dets.boxes) + jnp.sum(dets.scores)
+
+    step = jax.jit(lambda im: im + 1e-20 * run(im))
+    c = step(batch.images)
+    jax.device_get(c.ravel()[0])
+    n = 10 if on_accel else 2
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = step(c)
+    jax.device_get(c.ravel()[0])
+    dt = (time.perf_counter() - t0) / n
+    print(
+        f"eval: {dt * 1e3:.1f} ms/batch-of-{b} ({b / dt:.1f} img/s/chip)",
+        file=sys.stderr,
+    )
+    return b / dt, b
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default="r50_fpn_coco")
     ap.add_argument("--loader", action="store_true")
+    ap.add_argument(
+        "--eval", action="store_true",
+        help="bench forward_inference (proposals -> heads -> per-class NMS) "
+        "instead of the train step",
+    )
     args = ap.parse_args()
+    if args.eval and args.loader:
+        ap.error("--loader applies to the train bench only, not --eval")
 
     import jax
 
@@ -198,6 +271,21 @@ def main() -> None:
             cfg.train, steps_per_call=k, per_device_batch=batch
         ),
     )
+
+    if args.eval:
+        img_s, eb = _eval_bench(cfg, image_size, on_accel)
+        name = args.config.replace("_coco", "")
+        print(
+            json.dumps(
+                {
+                    "metric": f"eval_images_per_sec_per_chip[{name}@{image_size[0]}x{image_size[1]},b{eb},{platform}]",
+                    "value": round(img_s, 3),
+                    "unit": "img/s/chip",
+                    "vs_baseline": round(img_s / BASELINE_EVAL_IMG_S, 4),
+                }
+            )
+        )
+        return
     model, tx, state, step_fn, global_batch = build_all(cfg, mesh=None)
     data = _synthetic_batch(cfg, batch, image_size, k)
 

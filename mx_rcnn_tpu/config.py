@@ -51,6 +51,9 @@ class BackboneConfig:
     # backbone FLOPs for O(depth) less HBM — enables bigger canvases or
     # per-chip batches than stored activations would allow.
     remat: bool = False
+    # Execute the 7x7/2 RGB stem in space-to-depth form (exact rewrite,
+    # 4x denser MXU contraction — models/resnet.py::StemConv).  ResNet only.
+    stem_s2d: bool = False
 
 
 @dataclass(frozen=True)

@@ -16,7 +16,13 @@ def build_backbone(cfg: BackboneConfig, out_levels: tuple[int, ...] = (2, 3, 4, 
     dtype = _DTYPES[cfg.dtype]
     if cfg.name in STAGE_BLOCKS:
         return ResNet(blocks=STAGE_BLOCKS[cfg.name], norm=cfg.norm, dtype=dtype,
-                      out_levels=out_levels, remat=cfg.remat, name="backbone")
+                      out_levels=out_levels, remat=cfg.remat,
+                      stem_s2d=cfg.stem_s2d, name="backbone")
     if cfg.name == "vgg16":
+        if cfg.stem_s2d:
+            raise ValueError(
+                "backbone.stem_s2d is ResNet-only (VGG's stem is a 3x3/1 "
+                "conv stack with no strided RGB conv to rewrite)"
+            )
         return VGG16(dtype=dtype, remat=cfg.remat, name="backbone")
     raise ValueError(f"unknown backbone {cfg.name!r}")

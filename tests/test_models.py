@@ -48,6 +48,30 @@ class TestResNet:
         # R101 trunk (through C4/C5) is far larger than R50's.
         assert n_params > 25e6
 
+    def test_stem_s2d_exact_equivalence(self):
+        # The space-to-depth stem is an exact algebraic rewrite of the
+        # 7x7/2 conv: same params (identical pytree), same outputs in f32.
+        m0 = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32)
+        m1 = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32,
+                    stem_s2d=True)
+        x = jnp.asarray(np.random.RandomState(3).randn(2, 64, 96, 3),
+                        jnp.float32)
+        v0 = m0.init(jax.random.PRNGKey(0), x)
+        v1 = m1.init(jax.random.PRNGKey(0), x)
+        assert jax.tree_util.tree_structure(v0) == jax.tree_util.tree_structure(v1)
+        assert v0["params"]["conv1"]["kernel"].shape == (7, 7, 3, 64)
+        f0 = m0.apply(v0, x)
+        f1 = m1.apply(v0, x)  # same weights through the rewritten stem
+        for lvl in f0:
+            np.testing.assert_allclose(f0[lvl], f1[lvl], rtol=1e-5, atol=1e-4)
+
+    def test_stem_s2d_rejects_odd_canvas(self):
+        m = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32,
+                   stem_s2d=True, out_levels=(4,))
+        x = jnp.zeros((1, 63, 64, 3))
+        with pytest.raises(ValueError, match="even canvas"):
+            m.init(jax.random.PRNGKey(0), x)
+
     def test_bfloat16_compute_float32_params(self):
         m = ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.bfloat16, out_levels=(4,))
         x = jnp.zeros((1, 32, 32, 3))

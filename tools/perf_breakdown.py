@@ -71,6 +71,12 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--config", default="r50_fpn_coco")
     ap.add_argument(
+        "--infer", action="store_true",
+        help="break down forward_inference (eval path) instead of the "
+        "train step: features -> +proposals -> +box head -> full "
+        "(per-class NMS + top-D)",
+    )
+    ap.add_argument(
         "--set", dest="overrides", action="append", default=[],
         metavar="KEY.PATH=VALUE",
     )
@@ -118,6 +124,10 @@ def main() -> None:
     )
     key = jax.random.PRNGKey(1)
     mcfg = cfg.model
+
+    if args.infer:
+        _infer_breakdown(args, model, params, rest, batch, mcfg)
+        return
 
     # Shared front end (mirrors forward_train's structure).  Each stage is
     # "everything before it" + one more piece; all stages keep the RPN loss
@@ -224,7 +234,8 @@ def main() -> None:
     print("\ndeltas vs previous stage:")
     prev = None
     for name, dt in results:
-        print(f"{name:32s} +{(dt - (prev if prev is not None else dt)) * 1e3:7.2f} ms")
+        d = dt - (prev if prev is not None else 0.0)
+        print(f"{name:32s} +{d * 1e3:7.2f} ms")
         prev = dt
 
     # ---- standalone micro-benches of the usual non-MXU suspects ---------
@@ -267,6 +278,104 @@ def main() -> None:
     print(
         f"  NMS fixed point ({k} boxes) x{b} imgs  {dt*1e3:8.2f} ms"
         f"  (train path runs {n_lvl} levels/img)"
+    )
+
+
+def _infer_breakdown(args, model, params, rest, batch, mcfg) -> None:
+    """Ablation timing of forward_inference (the eval path), forward only.
+
+    Stages: backbone features -> +RPN/proposal gen -> +ROIAlign+box head ->
+    full inference (softmax, per-class decode, per-class NMS, global
+    top-D).  The chain carry is the image tensor (every stage reads it), so
+    each scanned step provably depends on the previous one."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.detection import forward_inference
+    from mx_rcnn_tpu.detection.graph import (
+        _pool_rois,
+        _postprocess_one,
+        _propose_on_features,
+    )
+
+    v = {"params": params, **rest}
+
+    def front(imgs, upto: str):
+        bt = batch._replace(images=imgs)
+        feats = model.apply(v, imgs, method="features")
+        if upto == "features":
+            s = sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in feats.values())
+            return imgs * 0.0 + s
+        props = _propose_on_features(model, v, feats, bt)
+        if upto == "proposals":
+            return imgs * 0.0 + (jnp.sum(props.rois) + jnp.sum(props.scores))
+        pooled = _pool_rois(
+            mcfg, feats, props.rois, mcfg.rcnn.pooled_size, model.roi_levels
+        )
+        ps = mcfg.rcnn.pooled_size
+        cls_logits, box_deltas = model.apply(
+            v, pooled.reshape(-1, ps, ps, pooled.shape[-1]), method="box"
+        )
+        if upto == "boxhead":
+            s = jnp.sum(cls_logits.astype(jnp.float32) ** 2) + jnp.sum(
+                box_deltas.astype(jnp.float32) ** 2
+            )
+            return imgs * 0.0 + s
+        raise ValueError(upto)
+
+    def full(imgs):
+        dets = forward_inference(model, v, batch._replace(images=imgs))
+        return imgs * 0.0 + (jnp.sum(dets.boxes) + jnp.sum(dets.scores))
+
+    b = batch.images.shape[0]
+    stages = [
+        ("backbone features", lambda im: front(im, "features")),
+        ("+rpn + proposal gen", lambda im: front(im, "proposals")),
+        ("+roialign + box head", lambda im: front(im, "boxhead")),
+        ("full inference (+postprocess)", full),
+    ]
+    results = []
+    for name, fn in stages:
+        dt = timed(jax.jit(fn), batch.images, args.steps)
+        results.append((name, dt))
+        print(
+            f"{name:32s} {dt * 1e3:8.2f} ms/batch  "
+            f"({b / dt:6.1f} img/s)", flush=True
+        )
+    print("\ndeltas vs previous stage:")
+    prev = None
+    for name, dt in results:
+        d = dt - (prev if prev is not None else 0.0)
+        print(f"{name:32s} +{d * 1e3:7.2f} ms")
+        prev = dt
+
+    # Standalone postprocess at eval shapes: R rois x (C-1) classes, NMS per
+    # class, global top-D — vmapped over the batch like the real path.
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    r = mcfg.rpn.test_post_nms_top_n
+    c = mcfg.num_classes
+    rois = np.asarray(rng.rand(b, r, 4) * 700, np.float32)
+    rois[..., 2:] += 16 + 150 * rng.rand(b, r, 2).astype(np.float32)
+    probs = jnp.asarray(rng.dirichlet(np.ones(c), size=(b, r)), jnp.float32)
+    deltas = jnp.asarray(
+        rng.randn(b, r, 1 if mcfg.rcnn.class_agnostic else c, 4) * 0.1,
+        jnp.float32,
+    )
+    rv = jnp.ones((b, r), bool)
+    hw = batch.image_hw
+
+    def post(pr):
+        out = jax.vmap(
+            lambda ro, rv_, p, d, hw_: _postprocess_one(mcfg, ro, rv_, p, d, hw_)
+        )(jnp.asarray(rois), rv, pr, deltas, hw)
+        return pr * 0.0 + (jnp.sum(out[0]) + jnp.sum(out[1]))
+
+    dt = timed(jax.jit(post), probs, args.steps)
+    print(
+        f"\nstandalone postprocess ({r} rois x {c - 1} classes) x{b}: "
+        f"{dt * 1e3:8.2f} ms"
     )
 
 
