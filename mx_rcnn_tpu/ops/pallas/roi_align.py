@@ -51,6 +51,10 @@ from mx_rcnn_tpu.ops.roi_align import fpn_level_assignment
 # detection/graph.py threads this SAME constant into both the single-chip
 # and shard_map'd call sites so the two can never silently diverge.
 POOL_WINDOW = 48
+# Fast-class window (see _prep): rois whose taps fit this corner DMA only
+# SMALL_WINDOW^2 cells instead of POOL_WINDOW^2.  Must be a multiple of 8
+# (Mosaic sublane slices).
+SMALL_WINDOW = 32
 
 
 def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
@@ -96,8 +100,9 @@ def _interp_matrix_avg(start, bin_size, num_bins, sr, extent, origin, t):
 
 
 def _kernel(
-    roi_ref,       # SMEM block (G, 1, 10) f32, G rois per grid step:
-                   # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch]
+    roi_ref,       # SMEM block (G, 1, 13) f32, G rois per grid step:
+                   # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch,
+                   #  small, oy_s, ox_s]
                    # Streamed per step, NOT scalar-prefetched: a prefetch
                    # table costs ~512 B of smem PER ROW, so an N = B*R
                    # batched-eval grid (8000 rois) would need 4 MB of the
@@ -113,54 +118,58 @@ def _kernel(
     out_ref = rest[num_levels]
     win = rest[num_levels + 1]     # (G, T, T, C) VMEM scratch
     sem = rest[num_levels + 2]     # DMA sems, shape (G,)
+    ts = min(SMALL_WINDOW, t)
 
     # Phase 1: start ALL G window DMAs, then wait — the copies fly
     # concurrently, amortizing HBM latency across the group (a 1-roi-per-
     # step grid serializes fetch->compute->fetch and measured ~10 ms for
-    # 1024 train rois; grouped fetches overlap).
-    for g in range(group):
-        level = roi_ref[g, 0, 6].astype(jnp.int32)
-        for i, f in enumerate(feat_refs):
-            th = min(t, f.shape[1])
-            tw = min(t, f.shape[2])
-            if th < t or tw < t:
-                @pl.when(level == i)
-                def _(g=g, th=th, tw=tw):
-                    win[g] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
+    # 1024 train rois; grouped fetches overlap).  Small-class rois (the
+    # majority — see _prep) copy only the (ts, ts) corner; cells beyond it
+    # hold stale finite scratch that every interpolation weight zeroes —
+    # which needs the scratch to START finite: uninitialized VMEM can hold
+    # NaN and 0 * NaN poisons the matmul, so step 0 memsets all windows
+    # once (later steps inherit real features or these zeros).
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        for g in range(group):
+            win[g] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
 
-    for g in range(group):
-        level = roi_ref[g, 0, 6].astype(jnp.int32)
-        oy = roi_ref[g, 0, 7].astype(jnp.int32)
-        ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
-        bi = roi_ref[g, 0, 9].astype(jnp.int32)
-        for i, f in enumerate(feat_refs):
-            th = min(t, f.shape[1])
-            tw = min(t, f.shape[2])
+    # (Cells a DMA never reaches — undersized levels, small-class corners —
+    # need no per-step re-zeroing: the extent/corner masking in the interp
+    # matrices gives them exactly-zero weight, and the step-0 memset keeps
+    # them finite for the whole grid.)
+    for phase in ("start", "wait"):
+        for g in range(group):
+            level = roi_ref[g, 0, 6].astype(jnp.int32)
+            oy = roi_ref[g, 0, 7].astype(jnp.int32)
+            ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
+            bi = roi_ref[g, 0, 9].astype(jnp.int32)
+            small = roi_ref[g, 0, 10] > 0.5
+            oy_s = roi_ref[g, 0, 11].astype(jnp.int32)
+            ox_s = pl.multiple_of(roi_ref[g, 0, 12].astype(jnp.int32), 8)
+            for i, f in enumerate(feat_refs):
+                th = min(t, f.shape[1])
+                tw = min(t, f.shape[2])
+                ths = min(ts, th)
+                tws = min(ts, tw)
 
-            @pl.when(level == i)
-            def _(f=f, th=th, tw=tw, g=g, oy=oy, ox=ox, bi=bi):
-                pltpu.make_async_copy(
-                    f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                    win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
-                    sem.at[g],
-                ).start()
+                @pl.when((level == i) & jnp.logical_not(small))
+                def _(g=g, f=f, th=th, tw=tw, oy=oy, ox=ox, bi=bi,
+                      phase=phase):
+                    getattr(pltpu.make_async_copy(
+                        f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                        win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
+                        sem.at[g],
+                    ), phase)()
 
-    for g in range(group):
-        level = roi_ref[g, 0, 6].astype(jnp.int32)
-        oy = roi_ref[g, 0, 7].astype(jnp.int32)
-        ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
-        bi = roi_ref[g, 0, 9].astype(jnp.int32)
-        for i, f in enumerate(feat_refs):
-            th = min(t, f.shape[1])
-            tw = min(t, f.shape[2])
-
-            @pl.when(level == i)
-            def _(f=f, th=th, tw=tw, g=g, oy=oy, ox=ox, bi=bi):
-                pltpu.make_async_copy(
-                    f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                    win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
-                    sem.at[g],
-                ).wait()
+                @pl.when((level == i) & small)
+                def _(g=g, f=f, ths=ths, tws=tws, oy_s=oy_s, ox_s=ox_s,
+                      bi=bi, phase=phase):
+                    getattr(pltpu.make_async_copy(
+                        f.at[bi, pl.ds(oy_s, ths), pl.ds(ox_s, tws), :],
+                        win.at[g, pl.ds(0, ths), pl.ds(0, tws), :],
+                        sem.at[g],
+                    ), phase)()
 
     # Phase 2: interpolate each roi's window (two small matmuls each, with
     # the sr x sr bin mean baked into the interpolation matrices — see
@@ -177,6 +186,10 @@ def _kernel(
         wl = roi_ref[g, 0, 5]
         oy = roi_ref[g, 0, 7].astype(jnp.int32)
         ox = roi_ref[g, 0, 8].astype(jnp.int32)
+        # The interpolation origin must match whichever window was DMA'd.
+        small = roi_ref[g, 0, 10] > 0.5
+        oy = jnp.where(small, roi_ref[g, 0, 11].astype(jnp.int32), oy)
+        ox = jnp.where(small, roi_ref[g, 0, 12].astype(jnp.int32), ox)
 
         wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)      # (S, T)
         wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox, t)      # (S, T)
@@ -258,14 +271,43 @@ def _prep(feature_pyramid, rois, output_size, window):
     ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - t, 0)).astype(jnp.int32)
     ox = (ox // 8) * 8
     bidx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), r_per)
+
+    # Small-window class: the kernel is DMA-bound (cost tracks T^2*C — the
+    # window bytes; measured 40.2 ms at T=48 vs 17.3 at T=32, eval shapes),
+    # and MOST rois fit a far smaller window than the worst case T must
+    # cover — the FPN level assignment targets ~7-20 cells of extent.
+    # Rois whose every nonzero tap fits a T_S window anchored at the
+    # T_S-clamped origin DMA only that corner; cells beyond it hold stale
+    # scratch with exactly-zero interpolation weight (finite garbage x 0).
+    ts = min(SMALL_WINDOW, t)
+    oy_s = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - ts, 0)).astype(jnp.int32)
+    ox_s = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - ts, 0)).astype(jnp.int32)
+    ox_s = (ox_s // 8) * 8
+    # Highest cell any sample can tap: floor of the largest clipped sample
+    # coordinate, +1 for the second bilinear tap, +1 more as f32 slack (the
+    # kernel recomputes coords as y1 + k*(rh/S), which can exceed y1 + rh
+    # by an ULP — the slack makes the bound robustly conservative).
+    y_hi = jnp.minimum(
+        jnp.floor(jnp.clip(y1 + rh, 0.0, hs - 1.0)) + 2.0, hs - 1.0
+    )
+    x_hi = jnp.minimum(
+        jnp.floor(jnp.clip(x1 + rw, 0.0, ws - 1.0)) + 2.0, ws - 1.0
+    )
+    small = (
+        (y_hi - oy_s.astype(jnp.float32) <= ts - 1)
+        & (x_hi - ox_s.astype(jnp.float32) <= ts - 1)
+    )
+
     # Indices ride the same f32 table as the geometry (exact for values
     # < 2^24; feature maps are nowhere near that) — see _kernel docstring.
     roi_params = jnp.stack(
         roi_geom
         + [level_idx.astype(jnp.float32), oy.astype(jnp.float32),
-           ox.astype(jnp.float32), bidx.astype(jnp.float32)],
+           ox.astype(jnp.float32), bidx.astype(jnp.float32),
+           small.astype(jnp.float32), oy_s.astype(jnp.float32),
+           ox_s.astype(jnp.float32)],
         axis=1,
-    ).astype(jnp.float32)[:, None, :]                          # (N, 1, 10)
+    ).astype(jnp.float32)[:, None, :]                          # (N, 1, 13)
     # 3-D so the SMEM block's last two dims equal the array's (Mosaic's
     # block-shape divisibility rule exempts full-extent dims).
     return levels, feats, ws_true, roi_params, b, r_per, batched
@@ -311,7 +353,7 @@ def multilevel_roi_align_pallas(
     n_pad = -n % grp
     if n_pad:
         roi_params = jnp.concatenate(
-            [roi_params, jnp.broadcast_to(roi_params[:1], (n_pad, 1, 10))]
+            [roi_params, jnp.broadcast_to(roi_params[:1], (n_pad, 1, 13))]
         )
 
     kernel = functools.partial(
@@ -327,7 +369,7 @@ def multilevel_roi_align_pallas(
         grid=((n + n_pad) // grp,),
         in_specs=[
             pl.BlockSpec(
-                (grp, 1, 10), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+                (grp, 1, 13), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
             )
         ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
         out_specs=pl.BlockSpec(
@@ -349,7 +391,7 @@ def multilevel_roi_align_pallas(
 
 
 def _bwd_kernel(
-    roi_ref,       # SMEM (1, 1, 10) f32 — same 10 fields as the forward.
+    roi_ref,       # SMEM (1, 1, 13) f32 — same 13 fields as the forward.
     g_ref,         # VMEM (1, S, S, C) — cotangent of this roi's pooled out.
     *rest,
     num_levels: int,
@@ -392,6 +434,17 @@ def _bwd_kernel(
     bin_h = roi_ref[0, 0, 3]
     hl = roi_ref[0, 0, 4]
     wl = roi_ref[0, 0, 5]
+    # Small-window class (see _prep/_kernel): the RMW traffic — 2x window
+    # bytes per roi — shrinks the same way the forward DMA does.  The
+    # interp origins must match the window actually read back.
+    small = roi_ref[0, 0, 10] > 0.5
+    ts = min(SMALL_WINDOW, t)
+    oy = jnp.where(small, roi_ref[0, 0, 11].astype(jnp.int32), oy)
+    # Re-annotate after the select: both branches are 8-aligned but Mosaic
+    # cannot prove it through a where, and the RMW HBM slice requires it.
+    ox = pl.multiple_of(
+        jnp.where(small, roi_ref[0, 0, 12].astype(jnp.int32), ox), 8
+    )
 
     s, sr = output_size, sampling_ratio
     wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)       # (S, T)
@@ -446,29 +499,32 @@ def _bwd_kernel(
     )                                                          # (Ty, Tx, C)
 
     for i, gl in enumerate(out_refs):
-        th = min(t, gl.shape[1])
-        tw = min(t, gl.shape[2])
+        for is_small in (False, True):
+            th = min(ts if is_small else t, gl.shape[1])
+            tw = min(ts if is_small else t, gl.shape[2])
+            cond = (level == i) & (small if is_small else jnp.logical_not(small))
 
-        @pl.when(level == i)
-        def _(gl=gl, th=th, tw=tw):
-            # Read-modify-write of the roi's window slice.  Taps beyond the
-            # level's true extent carry zero weight (the interp matrices
-            # mask by extent), so adding the [:th, :tw] corner is exact.
-            rd = pltpu.make_async_copy(
-                gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                win2.at[pl.ds(0, th), pl.ds(0, tw), :],
-                sem,
-            )
-            rd.start()
-            rd.wait()
-            win2[:th, :tw, :] = win2[:th, :tw, :] + d_window[:th, :tw, :]
-            wr = pltpu.make_async_copy(
-                win2.at[pl.ds(0, th), pl.ds(0, tw), :],
-                gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                sem,
-            )
-            wr.start()
-            wr.wait()
+            @pl.when(cond)
+            def _(gl=gl, th=th, tw=tw):
+                # Read-modify-write of the roi's window slice.  Taps beyond
+                # the level's true extent (and, for the small class, beyond
+                # the ts corner) carry zero weight in the interp matrices,
+                # so adding the [:th, :tw] corner is exact.
+                rd = pltpu.make_async_copy(
+                    gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                    win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                    sem,
+                )
+                rd.start()
+                rd.wait()
+                win2[:th, :tw, :] = win2[:th, :tw, :] + d_window[:th, :tw, :]
+                wr = pltpu.make_async_copy(
+                    win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                    gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
+                    sem,
+                )
+                wr.start()
+                wr.wait()
 
 
 @functools.partial(
@@ -511,7 +567,7 @@ def multilevel_roi_align_bwd_pallas(
         grid=(n,),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 10), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+                (1, 1, 13), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
             ),
             pl.BlockSpec(
                 (1, s, s, c), lambda r: (r, 0, 0, 0), memory_space=pltpu.VMEM

@@ -109,6 +109,84 @@ class TestPallasRoiAlign:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
+    def test_window_size_classes_match_xla(self, rng):
+        """Small-class rois (fit the SMALL_WINDOW corner) and large-class
+        rois (canvas-scale, clamped at the coarsest level) share one launch
+        and both match the oracle — covering the per-roi conditional DMA +
+        origin-select path and the stale-cells-are-zero-weighted argument."""
+        from mx_rcnn_tpu.ops.pallas.roi_align import SMALL_WINDOW
+
+        # Coarsest level = P3 of a 512 canvas (64-cell map), so a ~260 px
+        # roi clamps there at ~32.5 cells of extent: beyond the
+        # SMALL_WINDOW budget (large class) but within the 48-window's
+        # exact range.  Smaller pyramids cannot produce a large-class roi
+        # at all (every map fits the 32-corner whole).
+        canvas = 512
+        pyr = _pyramid(rng, canvas, levels=(2, 3))
+        small = np.array(_random_rois(rng, 24, canvas))
+        small[:, 2:] = small[:, :2] + np.minimum(
+            small[:, 2:] - small[:, :2], 40.0
+        )  # guaranteed tiny extent -> small class
+        giant = np.asarray(
+            [[3.0, 5.0, 263.0, 266.0], [200.0, 150.0, 462.0, 410.0]] * 4,
+            np.float32,
+        )  # ~260 px rois -> large class at the clamped coarsest level
+        rois = jnp.asarray(np.concatenate([small, giant]), jnp.float32)
+        # The class split must actually exercise BOTH branches.
+        from mx_rcnn_tpu.ops.pallas.roi_align import _prep
+
+        _, _, _, params, _, _, _ = _prep(pyr, rois, 7, 48)
+        flags = np.asarray(params[:, 0, 10])
+        assert flags.min() == 0.0 and flags.max() == 1.0
+        assert SMALL_WINDOW < 48
+        ref = multilevel_roi_align(pyr, rois, output_size=7, sampling_ratio=2)
+        out = multilevel_roi_align_pallas(
+            pyr, rois, output_size=7, sampling_ratio=2, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_window_size_classes_bwd_matches_xla_grad(self, rng):
+        """The BACKWARD's two-class RMW path on the same mixed small/large
+        roi set as the forward test above: the origin re-select and the
+        is_small branch pair in _bwd_kernel must scatter gradients into the
+        window the class actually reads, or recipe-canvas (large-class)
+        gradients silently land in the wrong cells while every tiny-canvas
+        test stays green."""
+        import jax
+
+        from mx_rcnn_tpu.ops.pallas import roi_align as pra
+        from mx_rcnn_tpu.ops.pallas.roi_align import _prep
+
+        canvas = 512
+        pyr = _pyramid(rng, canvas, levels=(2, 3))
+        small = np.array(_random_rois(rng, 8, canvas))
+        small[:, 2:] = small[:, :2] + np.minimum(
+            small[:, 2:] - small[:, :2], 40.0
+        )
+        giant = np.asarray(
+            [[3.0, 5.0, 263.0, 266.0], [200.0, 150.0, 462.0, 410.0]],
+            np.float32,
+        )
+        rois = jnp.asarray(np.concatenate([small, giant]), jnp.float32)
+        _, _, _, params, _, _, _ = _prep(pyr, rois, 7, 48)
+        flags = np.asarray(params[:, 0, 10])
+        assert flags.min() == 0.0 and flags.max() == 1.0
+
+        def loss_ref(p):
+            return (
+                multilevel_roi_align(
+                    p, rois, output_size=7, sampling_ratio=2
+                ) ** 2
+            ).sum()
+
+        g_ref = jax.grad(loss_ref)(pyr)
+        fwd = multilevel_roi_align(pyr, rois, output_size=7, sampling_ratio=2)
+        g_pyr, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), 2.0 * fwd)
+        for l in pyr:
+            np.testing.assert_allclose(
+                np.asarray(g_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
+            )
+
     def test_batched_matches_per_image(self, rng):
         """(B, R, 4) rois + (B, H, W, C) pyramid in ONE kernel launch equals
         the per-image calls it replaced."""
