@@ -68,6 +68,23 @@ def _synthetic_batch(cfg, batch, image_size, k):
     images = np.empty((n, h, w, 3), np.float32)
     for b in range(n):
         images[b] = rng.randn(h, w, 3)
+    masks = None
+    if cfg.model.mask.enabled:
+        # Box-relative gt masks, the loader's rasterized contract
+        # (data/loader.py::GT_MASK_SIZE); blobby half-coverage shapes so
+        # the mask loss sees both classes.
+        from mx_rcnn_tpu.data.loader import GT_MASK_SIZE
+
+        masks = np.zeros((n, g, GT_MASK_SIZE, GT_MASK_SIZE), np.float32)
+        yy, xx = np.mgrid[0:GT_MASK_SIZE, 0:GT_MASK_SIZE]
+        for b in range(n):
+            cy = rng.uniform(0.3, 0.7, n_gt) * GT_MASK_SIZE
+            cx = rng.uniform(0.3, 0.7, n_gt) * GT_MASK_SIZE
+            r = rng.uniform(0.2, 0.45, n_gt) * GT_MASK_SIZE
+            for j in range(n_gt):
+                masks[b, j] = (
+                    (yy - cy[j]) ** 2 + (xx - cx[j]) ** 2 <= r[j] ** 2
+                ).astype(np.float32)
     data = Batch(
         images=images,
         image_hw=np.tile(
@@ -76,6 +93,7 @@ def _synthetic_batch(cfg, batch, image_size, k):
         gt_boxes=boxes,
         gt_classes=classes,
         gt_valid=valid,
+        gt_masks=masks,
     )
     if k > 1:
         # Stacked (K, B, ...) layout consumed by the device-side lax.scan.
