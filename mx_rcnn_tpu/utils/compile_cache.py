@@ -1,0 +1,62 @@
+"""Shared persistent-compile-cache wiring for CPU-mesh harnesses.
+
+The test suite (``tests/conftest.py``) and the driver's multichip dryrun
+(``__graft_entry__._dryrun_multichip_impl``) both jit full sharded train
+steps on a fake CPU mesh — minutes of XLA:CPU compilation that a
+persistent cache turns into seconds on re-runs.  Both MUST key the cache
+directory the same way or they silently stop sharing it, so the keying
+lives here once.
+
+The key is a host-CPU-feature fingerprint: XLA:CPU AOT executables are
+codegen'd for the COMPILING machine, and loading another machine's blobs
+both risks SIGILL and silently changes numerics (an r3 bisect found a
+recorded golden that only reproduced because the cache replayed the
+recording machine's executables).
+
+Import note: this module's own imports are stdlib, but importing it pulls
+in the ``mx_rcnn_tpu`` package whose ``utils.__init__`` imports jax at
+module level.  That is backend-safe (importing jax does not initialize a
+backend) but means platform env vars (``JAX_PLATFORMS``, ``XLA_FLAGS``)
+must be pinned BEFORE this import — both current callers do so.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def cpu_fingerprint() -> str:
+    """Stable-ish hash of this host's CPU feature set.
+
+    x86 cpuinfo has a "flags" line; ARM uses "Features".  Fall back to the
+    full uname tuple (never empty, unlike ``platform.processor()``) so two
+    different hosts sharing a checkout can't collapse to one cache key.
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha1(repr(platform.uname()).encode()).hexdigest()[:8]
+
+
+def configure_cpu_cache(repo_root: str) -> str:
+    """Point jax's persistent compile cache at the shared fingerprinted dir.
+
+    Call only after the caller has pinned the platform to CPU (the cache
+    dir is CPU-keyed).  Returns the directory used.
+    """
+    import jax
+
+    cache_dir = os.path.join(
+        repo_root, "tests", ".jax_cache", cpu_fingerprint()
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    return cache_dir

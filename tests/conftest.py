@@ -35,37 +35,13 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compile cache: the integration tests jit full ResNet train
 # steps; caching makes re-runs of the suite seconds instead of minutes.
-# The dir is keyed by a host-CPU-feature fingerprint: XLA:CPU AOT
-# executables are codegen'd for the COMPILING machine, and loading another
-# machine's blobs both risks SIGILL and silently changes numerics (the r3
-# bisect found a recorded golden that only reproduced because the cache
-# replayed the recording machine's executables — jax logs machine-feature
-# mismatch warnings while doing so).
-import hashlib
+# Keying (host-CPU-feature fingerprint — foreign XLA:CPU blobs risk SIGILL
+# and silent numeric drift) is shared with the driver dryrun in
+# mx_rcnn_tpu/utils/compile_cache.py so the two never drift onto
+# different cache dirs.
+from mx_rcnn_tpu.utils.compile_cache import configure_cpu_cache  # noqa: E402
 
-
-def _cpu_fingerprint() -> str:
-    # x86 cpuinfo has a "flags" line; ARM uses "Features".  Fall back to the
-    # full uname tuple (never empty, unlike platform.processor()) so two
-    # different hosts sharing a checkout can't collapse to one cache key.
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith(("flags", "Features")):
-                    return hashlib.sha1(line.encode()).hexdigest()[:8]
-    except OSError:
-        pass
-    import platform
-
-    return hashlib.sha1(repr(platform.uname()).encode()).hexdigest()[:8]
-
-
-_cache_dir = os.path.join(
-    os.path.dirname(__file__), ".jax_cache", _cpu_fingerprint()
-)
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+configure_cpu_cache(os.path.dirname(os.path.dirname(__file__)))
 
 import numpy as np
 import pytest
