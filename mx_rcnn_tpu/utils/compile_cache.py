@@ -27,22 +27,59 @@ import os
 
 
 def cpu_fingerprint() -> str:
-    """Stable-ish hash of this host's CPU feature set.
+    """Stable-ish hash of this host's CPU identity and the compiler stack.
 
-    x86 cpuinfo has a "flags" line; ARM uses "Features".  Fall back to the
-    full uname tuple (never empty, unlike ``platform.processor()``) so two
-    different hosts sharing a checkout can't collapse to one cache key.
+    The key mixes, in order of specificity:
+
+    - every distinct ``flags`` / ``Features`` line from ``/proc/cpuinfo``
+      (sorted union, not just the first — heterogeneous ARM big.LITTLE
+      cores report differing Features lines and core enumeration order is
+      not stable);
+    - every distinct CPUID identity line (``vendor_id``, ``cpu family``,
+      ``model``, ``stepping``, ``model name``): r3 observed two hosts
+      whose kernel-reported flags were IDENTICAL while LLVM's target
+      features differed (``+prefer-no-scatter,+prefer-no-gather`` on one
+      side), so flags alone demonstrably CAN collapse two hosts to one
+      key (the foreign-AOT-blob replay in BASELINE.md's round-3
+      close-out).  XLA does not expose its LLVM host target-feature
+      string in-process (probed r4: ``backend.platform_version`` is just
+      ``"cpu"``), but LLVM *derives* those preference flags from CPUID
+      family/model/stepping — hashing them keys on the input to the
+      decision that actually differed.  ``model name`` alone would not do
+      it: virtualized builders report generic strings;
+    - the jaxlib version — AOT blob layout and XLA codegen both move with
+      it.
+
+    Only the uname fallback (no readable /proc/cpuinfo) carries the
+    original "two hosts can't collapse" guarantee; the cpuinfo path is
+    best-effort and a collision on all of the above, while now much
+    narrower, remains possible on truly identical fleet hardware — which
+    is also the one case where sharing blobs is safe.
+
+    Note: strengthening this key (r4) intentionally orphans caches warmed
+    under the flags-only r3 key; first runs after the change pay a full
+    recompile.
     """
+    import jaxlib
+
+    fields = ("flags", "Features", "vendor_id", "cpu family", "model", "stepping", "model name")
+    key = ""
     try:
         with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith(("flags", "Features")):
-                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+            lines = {
+                line.strip()
+                for line in f
+                if line.split(":")[0].strip() in fields
+            }
+        key = "\n".join(sorted(lines))
     except OSError:
         pass
-    import platform
+    if not key:
+        import platform
 
-    return hashlib.sha1(repr(platform.uname()).encode()).hexdigest()[:8]
+        key = repr(platform.uname())
+    key += "\njaxlib=" + jaxlib.version.__version__
+    return hashlib.sha1(key.encode()).hexdigest()[:8]
 
 
 def configure_cpu_cache(repo_root: str) -> str:
@@ -53,9 +90,33 @@ def configure_cpu_cache(repo_root: str) -> str:
     """
     import jax
 
-    cache_dir = os.path.join(
-        repo_root, "tests", ".jax_cache", cpu_fingerprint()
-    )
+    cache_root = os.path.join(repo_root, "tests", ".jax_cache")
+    cache_dir = os.path.join(cache_root, cpu_fingerprint())
+    # Key rotations (host change, jaxlib upgrade) orphan old sibling dirs.
+    # Builder hosts alternate between sessions on this shared checkout, so
+    # deleting every foreign sibling would wipe another host's warm cache
+    # each switch; instead keep the newest few by mtime and prune the rest
+    # so the root still can't grow monotonically across upgrades.
+    keep = 3
+    try:
+        # A fully-warm dir takes no new writes, so its mtime would freeze at
+        # warm-up time and age it toward eviction; touch it on every use so
+        # mtime means "last used", which is what the keep-newest rule wants.
+        if os.path.isdir(cache_dir):
+            os.utime(cache_dir)
+        sibs = [
+            os.path.join(cache_root, n)
+            for n in os.listdir(cache_root)
+            if os.path.isdir(os.path.join(cache_root, n))
+        ]
+        sibs.sort(key=os.path.getmtime, reverse=True)
+        for stale in sibs[keep:]:
+            if stale != cache_dir:
+                import shutil
+
+                shutil.rmtree(stale, ignore_errors=True)
+    except OSError:
+        pass
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
