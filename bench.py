@@ -63,11 +63,10 @@ def _synthetic_batch(cfg, batch, image_size, k):
     classes[:, :n_gt] = rng.randint(1, cfg.model.num_classes, (n, n_gt))
     valid = np.zeros((n, g), bool)
     valid[:, :n_gt] = True
-    # Fill per image: one randn(n, h, w, 3) call would transiently hold the
-    # whole stacked batch in float64 (~0.5 GB at k=10) before the cast.
-    images = np.empty((n, h, w, 3), np.float32)
-    for b in range(n):
-        images[b] = rng.randn(h, w, 3)
+    # uint8 pixels: the production loader ships raw letterboxed uint8 and
+    # the step normalizes in-graph (graph.py::prep_images), so the timed
+    # program must be that one.  Also 1/4 the device_put bytes.
+    images = rng.randint(0, 256, (n, h, w, 3), dtype=np.uint8)
     masks = None
     if cfg.model.mask.enabled:
         # Box-relative gt masks, the loader's rasterized contract
@@ -157,8 +156,12 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
     from mx_rcnn_tpu.train.loop import _stacked_batches
 
     k = max(cfg.train.steps_per_call, 1)
+    # uint8 synthetic pixels: same batch dtype as the main phase's program
+    # (no recompile) and the production transfer size — 3 MB/image at the
+    # recipe canvas instead of the f32 path's 12.
     roidb = SyntheticDataset(
-        num_images=max(global_batch * 2, 8), image_hw=cfg.data.image_size
+        num_images=max(global_batch * 2, 8), image_hw=cfg.data.image_size,
+        dtype="uint8",
     ).roidb()
     loader = DetectionLoader(
         roidb, cfg.data, batch_size=global_batch, prefetch=False

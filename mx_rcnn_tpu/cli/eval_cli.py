@@ -150,7 +150,10 @@ def run_eval(
     mesh = make_mesh() if jax.device_count() > 1 else None
     multiproc = jax.process_count() > 1
     model = TwoStageDetector(cfg=cfg.model)
-    eval_step = make_eval_step(model, mesh=mesh, gather_outputs=multiproc)
+    eval_step = make_eval_step(
+        model, mesh=mesh, gather_outputs=multiproc,
+        pixel_stats=(cfg.data.pixel_mean, cfg.data.pixel_std),
+    )
     # Pin the inference params on device ONCE.  Feeding the numpy pytree
     # into the jitted step would re-upload every parameter on every call —
     # ~100 MB/step through the TPU tunnel, turning an ~90 ms eval step into
@@ -257,8 +260,9 @@ def dump_proposals(
         if mesh is not None
         else jax.device_put(variables)
     )
+    stats = (cfg.data.pixel_mean, cfg.data.pixel_std)
     prop_step = make_sharded_infer(
-        lambda v, b: forward_proposals(model, v, b),
+        lambda v, b: forward_proposals(model, v, b, pixel_stats=stats),
         mesh, gather_outputs=multiproc,
     )
 

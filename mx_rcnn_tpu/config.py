@@ -173,6 +173,14 @@ class DataConfig:
     pixel_mean: tuple[float, float, float] = (123.675, 116.28, 103.53)
     pixel_std: tuple[float, float, float] = (58.395, 57.12, 57.375)
     aspect_grouping: bool = True
+    # Host-side normalization (the reference's rcnn/io/image.py::transform
+    # order).  Default OFF: the loader ships uint8 letterboxed pixels (1/4
+    # the host->device bytes and device_prefetch HBM of float32) and the
+    # (x - mean) / std runs in-graph, fused into the first conv's input
+    # (detection/graph.py::prep_images).  True restores float32 host
+    # normalization (the fused C++ path); in-memory float synthetic images
+    # always normalize on host regardless.
+    normalize_on_host: bool = False
     # VOC only: promote "difficult" objects to real gt instead of keeping
     # them as flagged ignore regions (reference:
     # ``rcnn/dataset/pascal_voc.py`` config.USE_DIFFICULT knob).

@@ -47,12 +47,17 @@ class SyntheticDataset:
         num_classes: int = 5,
         max_objects: int = 4,
         seed: int = 0,
+        dtype: str = "float32",
     ) -> None:
+        """``dtype="uint8"`` rounds the rendered pixels to uint8 — the
+        loader then ships them raw and normalizes in-graph, exactly like a
+        disk-backed dataset (float32 keeps the historical golden pixels)."""
         self.num_images = num_images
         self.image_hw = image_hw
         self.num_classes = num_classes  # incl. background 0
         self.max_objects = max_objects
         self.seed = seed
+        self.dtype = dtype
         self.classes = ("__background__",) + tuple(
             f"shape{c}" for c in range(1, num_classes)
         )
@@ -81,6 +86,8 @@ class SyntheticDataset:
             )
             boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
             classes.append(cls)
+        if self.dtype == "uint8":
+            img = np.clip(np.round(img), 0, 255).astype(np.uint8)
         return img, np.asarray(boxes, np.float32), np.asarray(classes, np.int32)
 
     def roidb(self) -> list[RoiRecord]:

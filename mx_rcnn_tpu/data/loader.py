@@ -262,27 +262,54 @@ class DetectionLoader:
         scale = self.record_scale(rec)
         nh = int(round(rec.height * scale))
         nw = int(round(rec.width * scale))
-        native = None
-        if img.dtype == np.uint8:
-            # Fused C++ resize+pad+normalize (mx_rcnn_tpu/native); replaces
-            # the reference's two-pass cv2-resize + numpy mean-subtract
-            # (rcnn/io/image.py) on the loader hot path.
-            from mx_rcnn_tpu.native import letterbox_normalize
+        if img.dtype == np.uint8 and not self.cfg.normalize_on_host:
+            # Default path: uint8 letterbox, normalization deferred into the
+            # jitted graph (graph.py::prep_images) — the batch ships 1/4 the
+            # bytes of float32 host-normalized pixels.  uint8->uint8 resize
+            # is also what the reference does (rcnn/io/image.py resizes the
+            # uint8 image before the float mean-subtract).
+            if cv2 is not None:
+                resized = cv2.resize(
+                    img, (nw, nh), interpolation=cv2.INTER_LINEAR
+                )
+            else:  # pragma: no cover
+                from PIL import Image
 
-            native = letterbox_normalize(
-                img, canvas, nh, nw, scale,
-                self.cfg.pixel_mean, self.cfg.pixel_std,
-            )
-        if native is not None:
-            img = native
+                # BILINEAR to match the cv2 INTER_LINEAR branch (PIL's
+                # default is BICUBIC — different pixels, cross-host drift).
+                resized = np.asarray(
+                    Image.fromarray(img).resize((nw, nh), Image.BILINEAR)
+                )
+            img = np.zeros((*canvas, 3), np.uint8)
+            img[:nh, :nw] = resized
             boxes = boxes.astype(np.float32) * scale
             th, tw = nh, nw
         else:
-            img, boxes, scale, (th, tw) = letterbox(
-                img.astype(np.float32), boxes, canvas,
-                self.cfg.short_side, self.cfg.max_side,
-            )
-            img = normalize_image(img, self.cfg.pixel_mean, self.cfg.pixel_std)
+            native = None
+            if img.dtype == np.uint8:
+                # Fused C++ resize+pad+normalize (mx_rcnn_tpu/native);
+                # replaces the reference's two-pass cv2-resize + numpy
+                # mean-subtract (rcnn/io/image.py) on the loader hot path.
+                # None when the shared library isn't built — fall through
+                # to the numpy letterbox.
+                from mx_rcnn_tpu.native import letterbox_normalize
+
+                native = letterbox_normalize(
+                    img, canvas, nh, nw, scale,
+                    self.cfg.pixel_mean, self.cfg.pixel_std,
+                )
+            if native is not None:
+                img = native
+                boxes = boxes.astype(np.float32) * scale
+                th, tw = nh, nw
+            else:
+                img, boxes, scale, (th, tw) = letterbox(
+                    img.astype(np.float32), boxes, canvas,
+                    self.cfg.short_side, self.cfg.max_side,
+                )
+                img = normalize_image(
+                    img, self.cfg.pixel_mean, self.cfg.pixel_std
+                )
         g = self.cfg.max_gt_boxes
         n = min(len(boxes), g)
         ign = rec.ignore_flags
@@ -335,6 +362,17 @@ class DetectionLoader:
         ims, hws, bs, cs, vs, igs, ms, ers, evs = [], [], [], [], [], [], [], [], []
         for rec, fl in zip(recs, flips):
             img, (th, tw), gb, gc, gv, gi, gm, ext, _ = self._example(rec, fl)
+            if ims and img.dtype != ims[0].dtype:
+                # A uint8 record rides raw (normalized in-graph) while a
+                # float record arrives host-normalized; np.stack would
+                # silently promote the mix to float32 and feed RAW 0-255
+                # uint8 pixels past prep_images' dtype gate.
+                raise ValueError(
+                    f"mixed image dtypes in one batch ({ims[0].dtype} vs "
+                    f"{img.dtype} for {rec.image_id!r}); a roidb must be "
+                    "uniformly uint8 or float (or set "
+                    "data.normalize_on_host=true)"
+                )
             ims.append(img)
             hws.append([th, tw])
             bs.append(gb)

@@ -64,8 +64,11 @@ def letterbox(
     else:  # pragma: no cover
         from PIL import Image
 
+        # BILINEAR to match cv2.INTER_LINEAR (PIL defaults to BICUBIC).
         resized = np.asarray(
-            Image.fromarray(image.astype(np.uint8)).resize((nw, nh))
+            Image.fromarray(image.astype(np.uint8)).resize(
+                (nw, nh), Image.BILINEAR
+            )
         )
     canvas = np.zeros((ch, cw, 3), dtype=np.float32)
     canvas[:nh, :nw] = resized

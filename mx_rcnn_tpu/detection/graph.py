@@ -47,7 +47,10 @@ from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
 class Batch(NamedTuple):
     """One statically-shaped training/eval batch (data/ produces these)."""
 
-    images: jnp.ndarray       # (B, H, W, 3) float32, normalized
+    # (B, H, W, 3): uint8 raw letterboxed pixels (default — normalized
+    # in-graph, see prep_images) or float32 already host-normalized
+    # (synthetic in-memory data, data.normalize_on_host=true).
+    images: jnp.ndarray
     image_hw: jnp.ndarray     # (B, 2) float32 true (unpadded) height, width
     gt_boxes: jnp.ndarray     # (B, G, 4)
     gt_classes: jnp.ndarray   # (B, G) int32, 0 = background/padding
@@ -388,6 +391,42 @@ def optax_sigmoid_ce(logits, labels):
 # Public graphs
 
 
+def prep_images(images: jnp.ndarray, pixel_stats=None) -> jnp.ndarray:
+    """In-graph image normalization for uint8 batches.
+
+    The reference normalizes on host (``rcnn/io/image.py::transform``) and
+    ships float32 — 12 MB/image at the recipe canvas.  Shipping the uint8
+    letterboxed pixels instead quarters host->device bytes and the
+    device_prefetch HBM footprint; the (x - mean) / std here is one fused
+    subtract/multiply XLA folds into the first conv's input, and it is the
+    same float32 math either side of the transfer.  The arithmetic follows
+    the native fused kernel's convention, (x - mean) * (1/std) with the
+    reciprocal precomputed in float32 (native/src/native.cc inv_std) — the
+    reciprocal is materialized HERE rather than left to XLA so the result
+    is bit-identical to that host path by construction, not by hoping the
+    compiler's divide-by-constant canonicalization rounds the same way (a
+    jnp divide measured 1 ULP off the host value on XLA:CPU).  The numpy
+    normalize_image divide can differ from either by 1 ULP per pixel.
+    float32 inputs pass through unchanged (they arrive already
+    normalized).  Padding behaves identically too: uint8 zeros normalize
+    to (0 - mean) * (1/std), the value the native kernel pads with.
+    """
+    if images.dtype != jnp.uint8:
+        return images
+    if pixel_stats is None:
+        raise ValueError(
+            "uint8 Batch.images need pixel_stats=(mean, std) for in-graph "
+            "normalization (pass cfg.data.pixel_mean / pixel_std)"
+        )
+    import numpy as np
+
+    mean = np.asarray(pixel_stats[0], np.float32)
+    inv_std = np.float32(1.0) / np.asarray(pixel_stats[1], np.float32)
+    return (images.astype(jnp.float32) - jnp.asarray(mean)) * jnp.asarray(
+        inv_std
+    )
+
+
 def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: int = 1):
     """Initialize all variables (params + frozen-BN constants)."""
     h, w = image_size
@@ -396,18 +435,20 @@ def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: in
 
 
 def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Batch,
-                  mesh=None):
+                  mesh=None, pixel_stats=None):
     """One full training forward pass -> (total_loss, metrics dict).
 
     Differentiable w.r.t. ``variables['params']``.  Equivalent of the
     reference's train symbol forward (SURVEY.md section 4.1 hot loop) with
     both CustomOp host syncs replaced by in-graph ops.  ``mesh``: >1-chip
     data mesh for the shard_map'd Pallas ROIAlign (see :func:`_pool_rois`).
+    ``pixel_stats``: (mean, std) for uint8 batches (see :func:`prep_images`).
     """
     cfg = model.cfg
-    feats = model.apply(variables, batch.images, method="features")
+    images = prep_images(batch.images, pixel_stats)
+    feats = model.apply(variables, images, method="features")
 
-    b = batch.images.shape[0]
+    b = images.shape[0]
     rng_assign, rng_sample = jax.random.split(rng)
 
     # gt_ignore=None keeps the cheaper no-IoA graph (in_axes=None maps the
@@ -550,16 +591,18 @@ def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w, gt_ignore=N
 
 
 def forward_inference(model: TwoStageDetector, variables, batch: Batch,
-                      mesh=None) -> Detections:
+                      mesh=None, pixel_stats=None) -> Detections:
     """Full inference: proposals -> box head -> per-class NMS -> top-D.
 
     Replaces ``rcnn/core/tester.py::im_detect`` + the per-class python NMS
     loop in ``pred_eval`` with one jitted region; detections come back
-    padded to ``cfg.test.max_detections`` with a validity mask.  ``mesh``:
-    see :func:`forward_train`.
+    padded to ``cfg.test.max_detections`` with a validity mask.  ``mesh``/
+    ``pixel_stats``: see :func:`forward_train`.
     """
     cfg = model.cfg
-    feats = model.apply(variables, batch.images, method="features")
+    feats = model.apply(
+        variables, prep_images(batch.images, pixel_stats), method="features"
+    )
     if batch.ext_rois is not None:
         # Fast R-CNN test mode (reference ``test_rcnn --has_rpn false``):
         # score externally supplied proposals; the RPN never runs.
@@ -626,7 +669,8 @@ def _propose_on_features(model, variables, feats, batch: Batch) -> Proposals:
     )(scores, deltas_cat, batch.image_hw)
 
 
-def forward_proposals(model: TwoStageDetector, variables, batch: Batch) -> Proposals:
+def forward_proposals(model: TwoStageDetector, variables, batch: Batch,
+                      pixel_stats=None) -> Proposals:
     """RPN-only inference: backbone -> RPN -> proposal generation.
 
     Replaces ``rcnn/core/tester.py::generate_proposals`` (used by
@@ -634,7 +678,9 @@ def forward_proposals(model: TwoStageDetector, variables, batch: Batch) -> Propo
     training phases).  Returns padded Proposals (rois, scores, valid) in
     input-image coordinates.
     """
-    feats = model.apply(variables, batch.images, method="features")
+    feats = model.apply(
+        variables, prep_images(batch.images, pixel_stats), method="features"
+    )
     return _propose_on_features(model, variables, feats, batch)
 
 

@@ -30,6 +30,7 @@ def make_train_step(
     spatial: bool = False,
     trainable_mask=None,
     steps_per_call: int = 1,
+    pixel_stats=None,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
@@ -79,7 +80,8 @@ def make_train_step(
                 )
             variables = {"params": params, **state.model_state}
             total, metrics = forward_train(
-                model, variables, rng, batch, mesh=roi_mesh
+                model, variables, rng, batch, mesh=roi_mesh,
+                pixel_stats=pixel_stats,
             )
             return total, metrics
 
@@ -179,11 +181,14 @@ def make_eval_step(
     model: TwoStageDetector,
     mesh: Optional[Mesh] = None,
     gather_outputs: bool = False,
+    pixel_stats=None,
 ):
     """Build ``eval_step(variables, batch) -> Detections`` (jitted)."""
 
     def step(variables, batch: Batch):
-        return forward_inference(model, variables, batch, mesh=mesh)
+        return forward_inference(
+            model, variables, batch, mesh=mesh, pixel_stats=pixel_stats
+        )
 
     return make_sharded_infer(step, mesh, gather_outputs)
 

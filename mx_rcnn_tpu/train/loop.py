@@ -153,6 +153,7 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     step_fn = make_train_step(
         model, tx, schedule, mesh=mesh, spatial=sp > 1,
         trainable_mask=trainable, steps_per_call=cfg.train.steps_per_call,
+        pixel_stats=(cfg.data.pixel_mean, cfg.data.pixel_std),
     )
     return model, tx, state, step_fn, global_batch
 

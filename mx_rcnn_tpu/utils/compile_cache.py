@@ -62,7 +62,15 @@ def cpu_fingerprint() -> str:
     """
     import jaxlib
 
-    fields = ("flags", "Features", "vendor_id", "cpu family", "model", "stepping", "model name")
+    fields = (
+        # x86 feature + identity lines.
+        "flags", "vendor_id", "cpu family", "model", "stepping", "model name",
+        # ARM equivalents: Features plus the CPUID identity (implementer/
+        # part/variant/revision are what LLVM's ARM host detection keys
+        # microarch tuning on, exactly as family/model/stepping on x86).
+        "Features", "CPU implementer", "CPU part", "CPU variant",
+        "CPU architecture", "CPU revision",
+    )
     key = ""
     try:
         with open("/proc/cpuinfo") as f:

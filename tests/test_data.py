@@ -614,3 +614,115 @@ class TestRoidbCache:
         cfg_b = dataclasses.replace(cfg, root=str(tmp_path / "b"))
         build_dataset(cfg_b, train=False).roidb()
         assert len(list((tmp_path / "cache").glob("coco_*_gt_roidb.pkl"))) == 2
+
+
+class TestUint8Pipeline:
+    """uint8 host->device images with in-graph normalization (the default
+    path): the loader ships raw letterboxed uint8 — 1/4 the transfer bytes
+    of the float32 host-normalized pipeline — and graph.prep_images does
+    the same (x - mean) / std in float32 on device, so pixels (and
+    therefore train metrics) are bit-identical either side."""
+
+    def _rec(self, rng, i=0, h=96, w=128):
+        return RoiRecord(
+            image_id=str(i), image_path="", height=h, width=w,
+            boxes=np.array([[5, 5, 60, 60]], np.float32),
+            gt_classes=np.array([1], np.int32),
+            image_array=(rng.rand(h, w, 3) * 255).astype(np.uint8),
+        )
+
+    def _cfg(self, **kw):
+        kw.setdefault("dataset", "synthetic")
+        kw.setdefault("image_size", (96, 128))
+        kw.setdefault("short_side", 96)
+        kw.setdefault("max_side", 128)
+        kw.setdefault("flip", False)
+        return DataConfig(**kw)
+
+    def test_default_ships_uint8(self, rng):
+        # 80x100 -> scale 96/80=1.2 -> resized 96x120 in a 96x128 canvas:
+        # cols 120.. are letterbox padding.
+        loader = DetectionLoader(
+            [self._rec(rng, h=80, w=100)], self._cfg(), batch_size=1,
+            train=False,
+        )
+        batch, _ = next(iter(loader))
+        assert batch.images.dtype == np.uint8
+        assert batch.images.shape[1:3] == (96, 128)
+        np.testing.assert_allclose(batch.image_hw[0], [96, 120])
+        # Padding region (beyond the resized extent) is uint8 zero, which
+        # prep_images normalizes to the same (0 - mean) / std value the
+        # host-normalized path pads with.
+        assert batch.images[0, :, 120:].max() == 0
+        assert batch.images[0, :96, :120].mean() > 50  # real pixels present
+
+    def test_normalize_on_host_flag_restores_float32(self, rng):
+        loader = DetectionLoader(
+            [self._rec(rng)], self._cfg(normalize_on_host=True),
+            batch_size=1, train=False,
+        )
+        batch, _ = next(iter(loader))
+        assert batch.images.dtype == np.float32
+
+    def test_in_graph_normalize_bitwise_matches_host(self, rng):
+        """prep_images(uint8) == (x - mean) * (1/std) in host float32
+        exactly — the native fused kernel's arithmetic convention (the
+        numpy normalize_image divide may differ by 1 ULP per pixel)."""
+        import jax.numpy as jnp
+
+        from mx_rcnn_tpu.detection.graph import prep_images
+
+        cfg = self._cfg()
+        loader = DetectionLoader(
+            [self._rec(rng)], cfg, batch_size=1, train=False
+        )
+        batch, _ = next(iter(loader))
+        dev = np.asarray(
+            prep_images(
+                jnp.asarray(batch.images), (cfg.pixel_mean, cfg.pixel_std)
+            )
+        )
+        mean = np.asarray(cfg.pixel_mean, np.float32)
+        inv = np.float32(1.0) / np.asarray(cfg.pixel_std, np.float32)
+        host = (batch.images.astype(np.float32) - mean) * inv
+        np.testing.assert_array_equal(dev, host)
+
+    def test_prep_images_float32_passthrough(self):
+        import jax.numpy as jnp
+
+        from mx_rcnn_tpu.detection.graph import prep_images
+
+        x = jnp.ones((1, 4, 4, 3), jnp.float32)
+        assert prep_images(x) is x
+
+    def test_prep_images_uint8_requires_stats(self):
+        import jax.numpy as jnp
+
+        from mx_rcnn_tpu.detection.graph import prep_images
+
+        with pytest.raises(ValueError, match="pixel_stats"):
+            prep_images(jnp.zeros((1, 4, 4, 3), jnp.uint8))
+
+    def test_synthetic_uint8_dtype(self):
+        ds = SyntheticDataset(num_images=2, image_hw=(64, 64), dtype="uint8")
+        recs = ds.roidb()
+        assert recs[0].image_array.dtype == np.uint8
+        loader = DetectionLoader(
+            recs, self._cfg(image_size=(64, 64), short_side=64, max_side=64),
+            batch_size=1, train=False,
+        )
+        batch, _ = next(iter(loader))
+        assert batch.images.dtype == np.uint8
+
+    def test_mixed_dtype_batch_rejected(self, rng):
+        import dataclasses
+
+        u8 = self._rec(rng, i=0)
+        f32 = dataclasses.replace(
+            u8, image_id="1", image_array=u8.image_array.astype(np.float32)
+        )
+        loader = DetectionLoader(
+            [u8, f32], self._cfg(), batch_size=2, train=False
+        )
+        with pytest.raises(ValueError, match="mixed image dtypes"):
+            next(iter(loader))

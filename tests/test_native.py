@@ -105,7 +105,12 @@ class TestLoaderUsesNative:
         rec_f32 = dataclasses.replace(
             rec_u8, image_id="f32", image_array=img.astype(np.float32)
         )
-        cfg = get_config("tiny_synthetic").data
+        # normalize_on_host routes the uint8 record through the native
+        # fused kernel (the default ships raw uint8 and normalizes
+        # in-graph — that path is covered in test_data.TestUint8Pipeline).
+        cfg = dataclasses.replace(
+            get_config("tiny_synthetic").data, normalize_on_host=True
+        )
         loader = DetectionLoader(
             [rec_u8, rec_f32], cfg, batch_size=1, train=False
         )
