@@ -193,13 +193,19 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
 def _eval_bench(cfg, image_size, on_accel):
     """Inference throughput: forward_inference at test.per_device_batch.
 
-    Timing method: N per-dispatch chained executions (input i+1 = input i +
-    1e-20 * f(output i), all on device) with ONE final fetch — each dispatch
-    provably executes the full forward, nothing can be hoisted.  A
-    scan-with-perturbed-carry form measured 7x slower on the same graph (an
-    XLA scan pathology with a 100 MB changing carry, r3 finding), so eval
-    numbers use the per-dispatch chain; it agrees with the 0-carry scan
-    form to ~3%."""
+    The timed graph is the PRODUCTION one: uint8 images normalized
+    in-graph (graph.py::prep_images), exactly what eval_cli runs on real
+    loader batches.
+
+    Timing method: N per-dispatch chained executions with ONE final fetch
+    — each dispatch provably executes the full forward, nothing can be
+    hoisted.  The chain rides the PARAMS (v_{i+1} = v_i + 1e-20 * f(v_i,
+    images), f32 leaves, buffers donated) because uint8 images cannot
+    absorb an infinitesimal perturbation; the r3 form chained through the
+    float images.  A scan-with-perturbed-carry form measured 7x slower on
+    the same graph (an XLA scan pathology with a 100 MB changing carry,
+    r3 finding), so eval numbers use the per-dispatch chain; it agrees
+    with the 0-carry scan form to ~3%."""
     import jax
     import jax.numpy as jnp
 
@@ -212,8 +218,9 @@ def _eval_bench(cfg, image_size, on_accel):
     variables = init_detector(model, jax.random.PRNGKey(0), (h, w))
     rng = np.random.RandomState(0)
     g = cfg.data.max_gt_boxes
+    stats = (cfg.data.pixel_mean, cfg.data.pixel_std)
     batch = Batch(
-        images=jnp.asarray(rng.randn(b, h, w, 3), jnp.float32),
+        images=jnp.asarray(rng.randint(0, 256, (b, h, w, 3), dtype=np.uint8)),
         image_hw=jnp.asarray([[float(h), float(w)]] * b, jnp.float32),
         gt_boxes=jnp.zeros((b, g, 4), jnp.float32),
         gt_classes=jnp.zeros((b, g), jnp.int32),
@@ -226,17 +233,23 @@ def _eval_bench(cfg, image_size, on_accel):
     variables = jax.device_put(variables)
 
     def run(v, imgs):
-        dets = forward_inference(model, v, batch._replace(images=imgs))
+        dets = forward_inference(
+            model, v, batch._replace(images=imgs), pixel_stats=stats
+        )
         return jnp.sum(dets.boxes) + jnp.sum(dets.scores)
 
-    step = jax.jit(lambda v, im: im + 1e-20 * run(v, im))
-    c = step(variables, batch.images)
-    jax.device_get(c.ravel()[0])
+    def chain(v, im):
+        eps = 1e-20 * run(v, im)
+        return jax.tree_util.tree_map(lambda p: p + eps.astype(p.dtype), v)
+
+    step = jax.jit(chain, donate_argnums=(0,))
+    variables = step(variables, batch.images)
+    jax.device_get(jax.tree_util.tree_leaves(variables)[0].ravel()[0])
     n = 10 if on_accel else 2
     t0 = time.perf_counter()
     for _ in range(n):
-        c = step(variables, c)
-    jax.device_get(c.ravel()[0])
+        variables = step(variables, batch.images)
+    jax.device_get(jax.tree_util.tree_leaves(variables)[0].ravel()[0])
     dt = (time.perf_counter() - t0) / n
     print(
         f"eval: {dt * 1e3:.1f} ms/batch-of-{b} ({b / dt:.1f} img/s/chip)",

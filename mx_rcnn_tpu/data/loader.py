@@ -26,6 +26,7 @@ from mx_rcnn_tpu.data.transforms import (
     flip_boxes,
     hflip,
     letterbox,
+    letterbox_uint8,
     normalize_image,
     oriented_canvas,
     resize_scale,
@@ -268,20 +269,7 @@ class DetectionLoader:
             # bytes of float32 host-normalized pixels.  uint8->uint8 resize
             # is also what the reference does (rcnn/io/image.py resizes the
             # uint8 image before the float mean-subtract).
-            if cv2 is not None:
-                resized = cv2.resize(
-                    img, (nw, nh), interpolation=cv2.INTER_LINEAR
-                )
-            else:  # pragma: no cover
-                from PIL import Image
-
-                # BILINEAR to match the cv2 INTER_LINEAR branch (PIL's
-                # default is BICUBIC — different pixels, cross-host drift).
-                resized = np.asarray(
-                    Image.fromarray(img).resize((nw, nh), Image.BILINEAR)
-                )
-            img = np.zeros((*canvas, 3), np.uint8)
-            img[:nh, :nw] = resized
+            img = letterbox_uint8(img, canvas, nh, nw)
             boxes = boxes.astype(np.float32) * scale
             th, tw = nh, nw
         else:

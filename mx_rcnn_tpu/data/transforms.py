@@ -43,6 +43,22 @@ def resize_scale(h: int, w: int, short_side: int, max_side: int) -> float:
     return scale
 
 
+def _resize_linear(image: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    """Bilinear resize, dtype-preserving.  One definition for every
+    letterbox path: cv2.INTER_LINEAR, with a PIL BILINEAR fallback that
+    MUST stay bilinear (PIL defaults to BICUBIC — different pixels,
+    cross-host drift)."""
+    if cv2 is not None:
+        return cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    from PIL import Image  # pragma: no cover
+
+    return np.asarray(  # pragma: no cover
+        Image.fromarray(image.astype(np.uint8)).resize(
+            (nw, nh), Image.BILINEAR
+        )
+    )
+
+
 def letterbox(
     image: np.ndarray,
     boxes: np.ndarray,
@@ -59,21 +75,24 @@ def letterbox(
     # rounding).
     scale = min(scale, ch / h, cw / w)
     nh, nw = int(round(h * scale)), int(round(w * scale))
-    if cv2 is not None:
-        resized = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
-    else:  # pragma: no cover
-        from PIL import Image
-
-        # BILINEAR to match cv2.INTER_LINEAR (PIL defaults to BICUBIC).
-        resized = np.asarray(
-            Image.fromarray(image.astype(np.uint8)).resize(
-                (nw, nh), Image.BILINEAR
-            )
-        )
     canvas = np.zeros((ch, cw, 3), dtype=np.float32)
-    canvas[:nh, :nw] = resized
+    canvas[:nh, :nw] = _resize_linear(image, nh, nw)
     out_boxes = boxes.astype(np.float32) * scale
     return canvas, out_boxes, scale, (nh, nw)
+
+
+def letterbox_uint8(
+    image: np.ndarray, canvas_hw: tuple[int, int], nh: int, nw: int
+) -> np.ndarray:
+    """The pixel half of :func:`letterbox` for the ship-raw-uint8 path:
+    uint8->uint8 bilinear resize to (nh, nw), pasted top-left into a
+    zeroed uint8 canvas.  The scale rule (and its canvas-overflow clamp)
+    ran upstream — ``DetectionLoader.record_scale`` — so nh/nw arrive
+    already bounded.  uint8 zeros in the padding normalize in-graph to
+    the same value the host-normalized path pads with."""
+    canvas = np.zeros((*canvas_hw, 3), np.uint8)
+    canvas[:nh, :nw] = _resize_linear(image, nh, nw)
+    return canvas
 
 
 def normalize_image(

@@ -34,6 +34,16 @@ class FrozenBatchNorm(nn.Module):
         return x * mul + add
 
 
+class Identity(nn.Module):
+    """No-op norm ("none"): the timing control for the FrozenBN-fusion A/B
+    (tools/perf_breakdown.py --backbone) and a building block for norm-free
+    experiments.  Parameterless."""
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x
+
+
 def make_norm(kind: str, dtype: jnp.dtype, name: str | None = None) -> nn.Module:
     if kind == "frozen_bn":
         return FrozenBatchNorm(dtype=dtype, name=name)
@@ -43,4 +53,6 @@ def make_norm(kind: str, dtype: jnp.dtype, name: str | None = None) -> nn.Module
         # Live BN is only sound with large per-device batches; exposed for
         # from-scratch recipes (SURVEY.md section 8 hard part #3).
         return nn.BatchNorm(use_running_average=True, dtype=dtype, name=name)
+    if kind == "none":
+        return Identity(name=name)
     raise ValueError(f"unknown norm {kind!r}")

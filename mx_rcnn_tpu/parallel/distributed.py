@@ -19,9 +19,13 @@ Launch parity:
              coordinator/rank/count explicitly or via
              JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES)
 
-The data loader shards the roidb per process (``roidb[rank::world]``,
-data/loader.py) and :func:`mx_rcnn_tpu.parallel.shard_batch` assembles
-global arrays from per-host shards — together with this module that is the
+The data path is the GLOBAL-schedule design (data/loader.py): every host
+keeps the full roidb, derives the identical global batch schedule
+(shuffle order, orientation buckets, flip draws), and decodes only its
+rank's rows of each global batch — lockstep per-step collectives by
+construction, with no per-host roidb slicing to desync them.
+:func:`mx_rcnn_tpu.parallel.shard_batch` then assembles each host's rows
+into the global device array.  Together with this module that is the
 complete multi-host story.
 """
 

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def timed(fn, arg, n, calls=3):
+def timed(fn, arg, n, calls=3, extra=None):
     """Time n dependency-chained executions of ``fn`` per device call.
 
     The chain lives INSIDE a ``lax.scan`` (one dispatch per n steps): each
@@ -41,22 +41,27 @@ def timed(fn, arg, n, calls=3):
     more than most stages' device compute, which is exactly why bench.py
     uses a scanned step loop; this tool must match it or the per-stage
     numbers drown in tunnel overhead (r3 finding: the unscanned version
-    read 159 ms for a stage the scanned version reads ~60 ms)."""
+    read 159 ms for a stage the scanned version reads ~60 ms).
 
-    def chain(carry):
+    ``extra``: a pytree of large scan-invariant inputs (feature maps,
+    params) passed as a jit ARGUMENT — closing over device arrays would
+    embed them as HLO constants in the remote-compile request (the
+    tunnel's request-size limit killed exactly that in bench.py)."""
+
+    def chain(carry, ex):
         def body(c, _):
-            out = fn(c)
+            out = fn(c) if ex is None else fn(c, ex)
             c2 = jax.tree_util.tree_map(lambda x, g: x + 0.0 * g, c, out)
             return c2, ()
 
         return jax.lax.scan(body, carry, None, length=n)[0]
 
     chained = jax.jit(chain)
-    carry = chained(arg)  # compile + warm
+    carry = chained(arg, extra)  # compile + warm
     jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
     t0 = time.perf_counter()
     for _ in range(calls):
-        carry = chained(carry)
+        carry = chained(carry, extra)
     jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
     return (time.perf_counter() - t0) / (n * calls)
 
@@ -75,6 +80,13 @@ def main() -> None:
         help="break down forward_inference (eval path) instead of the "
         "train step: features -> +proposals -> +box head -> full "
         "(per-class NMS + top-D)",
+    )
+    ap.add_argument(
+        "--backbone", action="store_true",
+        help="break down the backbone wall one level further: per-stage "
+        "trunk fwd+bwd (stem, +C2.., production freeze), the FrozenBN-vs-"
+        "identity fusion A/B, the FPN neck delta, and the per-level RPN "
+        "head cost",
     )
     ap.add_argument(
         "--set", dest="overrides", action="append", default=[],
@@ -125,6 +137,9 @@ def main() -> None:
     key = jax.random.PRNGKey(1)
     mcfg = cfg.model
 
+    if args.backbone:
+        _backbone_breakdown(args, cfg, model, params, rest, batch)
+        return
     if args.infer:
         _infer_breakdown(args, model, params, rest, batch, mcfg)
         return
@@ -279,6 +294,147 @@ def main() -> None:
         f"  NMS fixed point ({k} boxes) x{b} imgs  {dt*1e3:8.2f} ms"
         f"  (train path runs {n_lvl} levels/img)"
     )
+
+
+def _backbone_breakdown(args, cfg, model, params, rest, batch) -> None:
+    """One level below the step breakdown (VERDICT r4 #2): WHERE inside
+    the backbone wall the time goes.
+
+    - Trunk truncations (stem, +C2, +C3, +C4, +C5): fwd+bwd of a ResNet cut
+      after each stage, with the production freeze (conv1/bn1/layer1
+      stop-grad — their backward is DCE'd exactly as in the real step).
+      Fresh random inits: stage timing is value-independent.
+    - FrozenBN fusion A/B: the same full trunk with norm="none" (identity).
+      Equal times = the multiply-add fuses into the convs (the claim in
+      models/norm.py); a gap = each BN costs an HBM round trip.
+    - FPN neck delta: detector.features (trunk+FPN) minus trunk alone, on
+      the real variables.
+    - RPN head per level: the weight-shared head applied to each pyramid
+      level separately (activation bytes halve per level; P2 is the
+      prime suspect).
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax import traverse_util
+
+    from mx_rcnn_tpu.models.resnet import STAGE_BLOCKS, ResNet
+
+    name = cfg.model.backbone.name
+    if name not in STAGE_BLOCKS:
+        raise SystemExit(f"--backbone supports ResNets, not {name}")
+    blocks = STAGE_BLOCKS[name]
+    dtype = jnp.bfloat16
+    imgs = batch.images
+    key = jax.random.PRNGKey(0)
+    b = imgs.shape[0]
+
+    def frozen_stopgrad(p):
+        """Production freeze inside a bare trunk tree (FREEZE_PREFIXES
+        minus the 'backbone/' scope)."""
+        flat = traverse_util.flatten_dict(p)
+        out = {
+            k: (
+                jax.lax.stop_gradient(v)
+                if k[0] in ("conv1", "bn1") or k[0].startswith("layer1_")
+                else v
+            )
+            for k, v in flat.items()
+        }
+        return traverse_util.unflatten_dict(out)
+
+    def time_trunk(m, label):
+        vs = m.init(key, imgs)
+        p0 = vs["params"]
+        r0 = {k: v for k, v in vs.items() if k != "params"}
+
+        def loss(p, im):
+            out = m.apply({"params": frozen_stopgrad(p), **r0}, im)
+            return sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in out.values())
+
+        def grad_plus(p, im):
+            # value_and_grad, with the VALUE folded into the output: the
+            # stem+C2 truncation has every param frozen, so its grad is
+            # constant zeros and grad alone would let XLA DCE the whole
+            # forward — the row would time nothing (0.0 * val survives
+            # XLA's IEEE rules like the timing chain's 0.0 * g does).
+            val, g = jax.value_and_grad(loss)(p, im)
+            return jax.tree_util.tree_map(
+                lambda x: x + 0.0 * val.astype(x.dtype), g
+            )
+
+        dt = timed(jax.jit(grad_plus), p0, args.steps, extra=imgs)
+        print(f"{label:34s} {dt * 1e3:8.2f} ms/step fwd+bwd", flush=True)
+        return dt
+
+    print(f"trunk truncations ({name}, batch {b}, {imgs.shape[1]}x{imgs.shape[2]}):")
+    rows = []
+    for j, label in ((1, "stem+C2"), (2, "+C3"), (3, "+C4"), (4, "+C5 (full trunk)")):
+        m = ResNet(
+            blocks=blocks[:j], out_levels=tuple(range(2, j + 2)),
+            norm="frozen_bn", dtype=dtype,
+        )
+        rows.append((label, time_trunk(m, label)))
+    print("\nper-stage deltas:")
+    prev = 0.0
+    for label, dt in rows:
+        print(f"{label:34s} +{(dt - prev) * 1e3:7.2f} ms")
+        prev = dt
+
+    # FrozenBN fusion A/B on the full trunk.
+    m_none = ResNet(blocks=blocks, out_levels=(2, 3, 4, 5), norm="none", dtype=dtype)
+    dt_none = time_trunk(m_none, "full trunk, norm=none (A/B)")
+    dt_bn = rows[-1][1]
+    print(
+        f"FrozenBN cost across the trunk: {(dt_bn - dt_none) * 1e3:+.2f} ms "
+        f"({'fused/free' if abs(dt_bn - dt_none) < 0.05 * dt_bn else 'NOT free'})"
+    )
+
+    # FPN neck + per-level RPN head on the real model/variables.
+    v = {"params": params, **rest}
+    feats = jax.jit(
+        lambda vv, im: model.apply(vv, im, method="features")
+    )(v, imgs)
+    feats = jax.device_put(feats)
+
+    def feats_loss(p, im):
+        out = model.apply({"params": p, **rest}, im, method="features")
+        return sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in out.values())
+
+    # Freeze via the production mask: loop.FREEZE_PREFIXES paths.
+    from mx_rcnn_tpu.train.loop import FREEZE_PREFIXES
+    from mx_rcnn_tpu.train.optim import frozen_mask
+
+    mask = frozen_mask(params, FREEZE_PREFIXES.get(name, ()))
+
+    def masked(p):
+        return jax.tree_util.tree_map(
+            lambda x, t: x if t else jax.lax.stop_gradient(x), p, mask
+        )
+
+    grad_feats = jax.jit(lambda p, im: jax.grad(
+        lambda pp, i: feats_loss(masked(pp), i)
+    )(p, im))
+    dt_feats = timed(grad_feats, params, args.steps, extra=imgs)
+    print(
+        f"\n{'features (trunk+FPN neck)':34s} {dt_feats * 1e3:8.2f} ms/step"
+        f"  (FPN delta vs trunk: {(dt_feats - dt_bn) * 1e3:+.2f} ms)"
+    )
+
+    levels = sorted(feats)
+    for lvls in [levels] + [[l] for l in levels]:
+        sub = {l: feats[l] for l in lvls}
+
+        def rpn_loss(p, ft):
+            out = model.apply({"params": p, **rest}, ft, method="rpn")
+            return sum(
+                jnp.sum(o.astype(jnp.float32) ** 2)
+                for pair in out.values() for o in pair
+            )
+
+        grad_rpn = jax.jit(lambda p, ft: jax.grad(rpn_loss)(p, ft))
+        dt = timed(grad_rpn, params, args.steps, extra=sub)
+        tag = "all levels" if len(lvls) > 1 else f"P{lvls[0]} only"
+        print(f"{'rpn head ' + tag:34s} {dt * 1e3:8.2f} ms/step fwd+bwd")
 
 
 def _infer_breakdown(args, model, params, rest, batch, mcfg) -> None:
