@@ -267,6 +267,11 @@ def main() -> None:
         help="bench forward_inference (proposals -> heads -> per-class NMS) "
         "instead of the train step",
     )
+    ap.add_argument(
+        "--set", dest="overrides", action="append", default=[],
+        metavar="KEY.PATH=VALUE",
+        help="config overrides for A/B probes (same syntax as train.py)",
+    )
     args = ap.parse_args()
     if args.eval and args.loader:
         ap.error("--loader applies to the train bench only, not --eval")
@@ -282,7 +287,7 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
 
-    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.config import apply_overrides, get_config
     from mx_rcnn_tpu.train.loop import build_all
 
     platform = jax.default_backend()
@@ -310,6 +315,14 @@ def main() -> None:
             cfg.train, steps_per_call=k, per_device_batch=batch
         ),
     )
+    if args.overrides:
+        # Overrides win over the bench defaults above — and the locals the
+        # synthetic batch / metric label derive from must follow them, or
+        # an overridden canvas/batch would silently bench stale shapes.
+        cfg = apply_overrides(cfg, args.overrides)
+        image_size = cfg.data.image_size
+        batch = cfg.train.per_device_batch
+        k = max(cfg.train.steps_per_call, 1)
 
     if args.eval:
         img_s, eb = _eval_bench(cfg, image_size, on_accel)

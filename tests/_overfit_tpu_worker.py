@@ -1,0 +1,49 @@
+"""Subprocess body for the opt-in TPU overfit golden.
+
+Runs the tiny_synthetic overfit recipe (the same one
+tests/test_overfit.py pins on CPU) on whatever accelerator the image's
+default platform resolution picks — under the axon sitecustomize that is
+the real TPU chip.  Prints one RESULT json line with the eval metrics
+and the platform/device count so the parent can gate on them.
+
+Run directly: python tests/_overfit_tpu_worker.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    from mx_rcnn_tpu.cli.eval_cli import run_eval
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.train.loop import train
+
+    cfg = get_config("tiny_synthetic")
+    sched = dataclasses.replace(
+        cfg.train.schedule, base_lr=0.02, warmup_steps=20,
+        decay_steps=(300,), total_steps=400,
+    )
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, schedule=sched, log_every=100)
+    )
+    state = train(cfg, mesh=None)
+    metrics = run_eval(cfg, state=state)
+    out = {
+        "platform": jax.default_backend(),
+        "devices": jax.device_count(),
+        "AP": float(metrics["AP"]),
+        "AP50": float(metrics["AP50"]),
+    }
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Opt-in TPU overfit golden (VERDICT r3 #6).
+
+The r3 bisect proved the synthetic-overfit AP is bit-identical across
+code states PER PLATFORM (TPU read 0.473 at every probed r1/r2 state
+while CPU read 0.7789) — so a tight pin IS valid on one platform even
+though the 4-image recipe is chaotic across codegen environments.  This
+gate pins the TPU value so on-TPU regressions stop hiding inside the
+CPU floor's slack (AP > 0.40 admits a 0.78 -> 0.41 silent drop).
+
+The suite's conftest pins every in-process test to the fake CPU mesh,
+so the recipe runs in a subprocess WITHOUT the platform pin — under the
+axon sitecustomize the default platform is the real chip.  Gated behind
+RUN_OVERFIT_TPU=1: it needs the TPU (~3-5 min through the tunnel) and
+the default suite must stay hermetic on CPU.
+
+Golden provenance: see BASELINE.md's synthetic-overfit row.  A golden
+shift after a jax/libtpu upgrade is expected (re-record with the
+BASELINE note); a shift after a CODE change is the regression signal
+this test exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.environ.get("RUN_OVERFIT_TPU"),
+        reason="set RUN_OVERFIT_TPU=1 (needs the TPU; ~3-5 min)",
+    ),
+]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Recorded on the r4 bench chip (TPU v5e via axon), single device,
+# batch 1 (mesh=None on a 1-chip runtime).  The r3 bisect's recorded
+# value for this recipe/platform pair.
+TPU_GOLDEN_AP = 0.473
+TOLERANCE = 0.01
+
+
+def test_tpu_overfit_golden():
+    env = dict(os.environ)
+    # No JAX_PLATFORMS / XLA_FLAGS surgery: the subprocess must resolve
+    # the platform exactly as production CLIs do (axon -> real chip).
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "_overfit_tpu_worker.py")],
+        env=env, capture_output=True, text=True, timeout=3000,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert lines, proc.stdout[-2000:]
+    out = json.loads(lines[-1][len("RESULT "):])
+    assert out["platform"] == "tpu", out
+    assert abs(out["AP"] - TPU_GOLDEN_AP) <= TOLERANCE, (
+        f"TPU overfit AP {out['AP']:.4f} moved more than {TOLERANCE} from "
+        f"the recorded golden {TPU_GOLDEN_AP} — either a real on-TPU "
+        f"regression or a runtime upgrade; see BASELINE.md overfit row "
+        f"before re-recording.  Full: {out}"
+    )

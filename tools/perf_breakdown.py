@@ -29,6 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Same persistent compile cache as bench.py: iterating on one stage should
+# not recompile the other seven.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+
 
 def timed(fn, arg, n, calls=3, extra=None):
     """Time n dependency-chained executions of ``fn`` per device call.
@@ -92,6 +100,20 @@ def main() -> None:
         "--set", dest="overrides", action="append", default=[],
         metavar="KEY.PATH=VALUE",
     )
+    ap.add_argument(
+        "--only", default=None, metavar="SUBSTR",
+        help="run only train-breakdown stages whose name contains this "
+        "substring (skips the optimizer row and micro-benches too)",
+    )
+    ap.add_argument(
+        "--freeze", action=argparse.BooleanOptionalAction, default=None,
+        help="apply the production freeze (stop-grad conv1/bn1/layer1 — "
+        "their backward is DCE'd exactly as in the real step).  Default: "
+        "follow the config (freeze_stages > 0), matching build_all.  The "
+        "r3 tables were recorded with --no-freeze semantics and overstate "
+        "the backbone wall by the frozen stages' backward (~20 ms on "
+        "R101-FPN at recipe shapes)",
+    )
     args = ap.parse_args()
 
     from mx_rcnn_tpu.config import apply_overrides, get_config
@@ -144,12 +166,33 @@ def main() -> None:
         _infer_breakdown(args, model, params, rest, batch, mcfg)
         return
 
+    freeze_on = (
+        args.freeze
+        if args.freeze is not None
+        else cfg.model.backbone.freeze_stages > 0
+    )
+    if freeze_on:
+        from mx_rcnn_tpu.train.loop import FREEZE_PREFIXES
+        from mx_rcnn_tpu.train.optim import frozen_mask
+
+        _mask = frozen_mask(
+            params, FREEZE_PREFIXES.get(cfg.model.backbone.name, ())
+        )
+
+        def masked(p):
+            return jax.tree_util.tree_map(
+                lambda x, t: x if t else jax.lax.stop_gradient(x), p, _mask
+            )
+    else:
+        def masked(p):
+            return p
+
     # Shared front end (mirrors forward_train's structure).  Each stage is
     # "everything before it" + one more piece; all stages keep the RPN loss
     # term so the backbone backward exists in every variant (in the real
     # graph proposals/sampling are stop-grad side computations).
     def front(p, upto: str):
-        v = {"params": p, **rest}
+        v = {"params": masked(p), **rest}
         feats = model.apply(v, batch.images, method="features")
         if upto == "backbone":
             return sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in feats.values())
@@ -194,6 +237,17 @@ def main() -> None:
           batch.gt_classes, batch.gt_valid)
         if upto == "sample":
             return loss + jnp.sum(samples.rois) * 1e-30
+        if upto == "pool_fwd":
+            # Forward-only pooling: cut the feature cotangent so the delta
+            # vs "sample" isolates the kernel FORWARD in-graph, and the
+            # "pool" - "pool_fwd" gap isolates backward + the cost of
+            # merging a second cotangent into the shared trunk backward.
+            pooled = _pool_rois(
+                mcfg,
+                jax.tree_util.tree_map(jax.lax.stop_gradient, feats),
+                samples.rois, mcfg.rcnn.pooled_size, model.roi_levels,
+            )
+            return loss + jnp.sum(pooled.astype(jnp.float32) ** 2) * 1e-30
         pooled = _pool_rois(
             mcfg, feats, samples.rois, mcfg.rcnn.pooled_size, model.roi_levels
         )
@@ -202,7 +256,7 @@ def main() -> None:
         raise ValueError(upto)
 
     def stage_full(p):
-        loss, _ = forward_train(model, {"params": p, **rest}, key, batch)
+        loss, _ = forward_train(model, {"params": masked(p), **rest}, key, batch)
         return loss
 
     stages = [
@@ -211,15 +265,30 @@ def main() -> None:
         ("+assign+rpn losses", lambda p: front(p, "rpnloss")),
         ("+proposal gen (stop-grad)", lambda p: front(p, "proposals")),
         ("+sample_rois (stop-grad)", lambda p: front(p, "sample")),
-        ("+roialign (stop-grad)", lambda p: front(p, "pool")),
+        ("+roialign fwd only", lambda p: front(p, "pool_fwd")),
+        ("+roialign fwd+bwd", lambda p: front(p, "pool")),
         ("full forward_train+bwd", stage_full),
     ]
+    if args.only:
+        stages = [s for s in stages if args.only in s[0]]
     results = []
     for name, fn in stages:
-        grad = jax.jit(jax.grad(fn))
-        dt = timed(grad, params, args.steps)
+        def grad_plus(p, fn=fn):
+            # value_and_grad with the VALUE folded into the output:
+            # value-only side branches (the pool_fwd stage's stop-grad
+            # pooling) otherwise get DCE'd under jax.grad and time as 0.
+            val, g = jax.value_and_grad(fn)(p)
+            return jax.tree_util.tree_map(
+                lambda x: x + 0.0 * val.astype(x.dtype), g
+            )
+
+        dt = timed(jax.jit(grad_plus), params, args.steps)
         results.append((name, dt))
         print(f"{name:32s} {dt * 1e3:8.2f} ms/step", flush=True)
+
+    if args.only:
+        _print_deltas(results, filtered=True)
+        return
 
     # Full production step incl. optimizer (delta vs the grad-only full
     # stage = clip + wd + sgd + state bookkeeping).
@@ -246,12 +315,7 @@ def main() -> None:
     results.append(("full step + optimizer", dt))
     print(f"{'full step + optimizer':32s} {dt * 1e3:8.2f} ms/step", flush=True)
 
-    print("\ndeltas vs previous stage:")
-    prev = None
-    for name, dt in results:
-        d = dt - (prev if prev is not None else 0.0)
-        print(f"{name:32s} +{d * 1e3:7.2f} ms")
-        prev = dt
+    _print_deltas(results)
 
     # ---- standalone micro-benches of the usual non-MXU suspects ---------
     print("\nisolated micro-benches (forward only, per step):")
@@ -294,6 +358,25 @@ def main() -> None:
         f"  NMS fixed point ({k} boxes) x{b} imgs  {dt*1e3:8.2f} ms"
         f"  (train path runs {n_lvl} levels/img)"
     )
+
+
+def _print_deltas(results, filtered: bool = False) -> None:
+    """``filtered``: a --only run — the first surviving row has no
+    predecessor, so its cumulative time is printed as an absolute (a
+    '+delta' there would mislabel everything upstream of the filter as
+    this stage's cost), and later rows may skip stages in between."""
+    print(
+        "\ndeltas vs previous stage"
+        + (" (filtered: first row is ABSOLUTE; gaps possible):" if filtered else ":")
+    )
+    prev = None
+    for name, dt in results:
+        if prev is None and filtered:
+            print(f"{name:32s} ={dt * 1e3:8.2f} ms (cumulative)")
+        else:
+            d = dt - (prev if prev is not None else 0.0)
+            print(f"{name:32s} +{d * 1e3:7.2f} ms")
+        prev = dt
 
 
 def _backbone_breakdown(args, cfg, model, params, rest, batch) -> None:
@@ -363,8 +446,15 @@ def _backbone_breakdown(args, cfg, model, params, rest, batch) -> None:
             )
 
         dt = timed(jax.jit(grad_plus), p0, args.steps, extra=imgs)
-        print(f"{label:34s} {dt * 1e3:8.2f} ms/step fwd+bwd", flush=True)
-        return dt
+        from mx_rcnn_tpu.utils.flops import count_matmul_flops
+
+        fl = count_matmul_flops(grad_plus, p0, imgs)
+        print(
+            f"{label:34s} {dt * 1e3:8.2f} ms/step fwd+bwd"
+            f"  ({fl / 1e12:5.2f} TF, {fl / dt / 1e12:5.1f} TF/s)",
+            flush=True,
+        )
+        return dt, fl
 
     print(f"trunk truncations ({name}, batch {b}, {imgs.shape[1]}x{imgs.shape[2]}):")
     rows = []
@@ -373,21 +463,29 @@ def _backbone_breakdown(args, cfg, model, params, rest, batch) -> None:
             blocks=blocks[:j], out_levels=tuple(range(2, j + 2)),
             norm="frozen_bn", dtype=dtype,
         )
-        rows.append((label, time_trunk(m, label)))
-    print("\nper-stage deltas:")
-    prev = 0.0
-    for label, dt in rows:
-        print(f"{label:34s} +{(dt - prev) * 1e3:7.2f} ms")
-        prev = dt
+        rows.append((label, *time_trunk(m, label)))
+    print("\nper-stage deltas (delta-MFU of v5e bf16 peak 197 TF/s):")
+    prev_t = prev_f = 0.0
+    for label, dt, fl in rows:
+        ddt, dfl = dt - prev_t, fl - prev_f
+        mfu = dfl / max(ddt, 1e-9) / 197e12 * 100
+        print(f"{label:34s} +{ddt * 1e3:7.2f} ms  ({dfl/1e12:5.2f} TF, {mfu:4.1f}% MFU)")
+        prev_t, prev_f = dt, fl
 
     # FrozenBN fusion A/B on the full trunk.
     m_none = ResNet(blocks=blocks, out_levels=(2, 3, 4, 5), norm="none", dtype=dtype)
-    dt_none = time_trunk(m_none, "full trunk, norm=none (A/B)")
+    dt_none, _ = time_trunk(m_none, "full trunk, norm=none (A/B)")
     dt_bn = rows[-1][1]
     print(
         f"FrozenBN cost across the trunk: {(dt_bn - dt_none) * 1e3:+.2f} ms "
         f"({'fused/free' if abs(dt_bn - dt_none) < 0.05 * dt_bn else 'NOT free'})"
     )
+    m_fold = ResNet(
+        blocks=blocks, out_levels=(2, 3, 4, 5), norm="frozen_bn",
+        fold_bn=True, dtype=dtype,
+    )
+    dt_fold, _ = time_trunk(m_fold, "full trunk, fold_bn=true (A/B)")
+    print(f"fold_bn recovers: {(dt_bn - dt_fold) * 1e3:+.2f} ms of the BN cost")
 
     # FPN neck + per-level RPN head on the real model/variables.
     v = {"params": params, **rest}
