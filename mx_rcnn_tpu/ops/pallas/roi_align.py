@@ -51,10 +51,24 @@ from mx_rcnn_tpu.ops.roi_align import fpn_level_assignment
 # detection/graph.py threads this SAME constant into both the single-chip
 # and shard_map'd call sites so the two can never silently diverge.
 POOL_WINDOW = 48
-# Fast-class window (see _prep): rois whose taps fit this corner DMA only
-# SMALL_WINDOW^2 cells instead of POOL_WINDOW^2.  Must be a multiple of 8
-# (Mosaic sublane slices).
-SMALL_WINDOW = 32
+
+
+def window_classes(t: int) -> tuple[tuple[int, int], ...]:
+    """Per-roi (Ty, Tx) window classes, smallest first; the last is the
+    full (t, t) fallback whose clamp semantics define exactness.
+
+    The kernels are window-DMA-bound (cost tracks Ty*Tx*C), and the FPN
+    level assignment targets ~7-20 cells of roi extent, so most rois need
+    far less than the worst-case window.  The r4 eval-shape distribution
+    probe (random-weight proposals, recipe canvas): y-need p50/p90 =
+    10/20 cells, x-need (which carries the origin's 8-alignment slack,
+    up to +7) p50/p90 = 21/25 — (16, 24) fits 72% of rois and (24, 32)
+    fits 100%, where the single 32-corner class shipped 1024 cells for
+    every one of them.  Ty is unconstrained (H is the untiled dim); Tx
+    must be a multiple of 8 (Mosaic sublane slicing).
+    """
+    base = [(ty, tx) for ty, tx in ((16, 24), (24, 32)) if ty < t and tx < t]
+    return tuple(base) + ((t, t),)
 
 
 def _interp_matrix(start, bin_size, num_bins, sr, extent, origin, t):
@@ -100,9 +114,9 @@ def _interp_matrix_avg(start, bin_size, num_bins, sr, extent, origin, t):
 
 
 def _kernel(
-    roi_ref,       # SMEM block (G, 1, 13) f32, G rois per grid step:
-                   # [x1, y1, bin_w, bin_h, H, W, level_idx, oy, ox, batch,
-                   #  small, oy_s, ox_s]
+    roi_ref,       # SMEM block (G, 1, 9+2K) f32, G rois per grid step:
+                   # [x1, y1, bin_w, bin_h, H, W, level_idx, batch,
+                   #  (oy_c, ox_c) x K classes, cls]
                    # Streamed per step, NOT scalar-prefetched: a prefetch
                    # table costs ~512 B of smem PER ROW, so an N = B*R
                    # batched-eval grid (8000 rois) would need 4 MB of the
@@ -118,63 +132,55 @@ def _kernel(
     out_ref = rest[num_levels]
     win = rest[num_levels + 1]     # (G, T, T, C) VMEM scratch
     sem = rest[num_levels + 2]     # DMA sems, shape (G,)
-    ts = min(SMALL_WINDOW, t)
+    classes = window_classes(t)
 
     # Phase 1: start ALL G window DMAs, then wait — the copies fly
     # concurrently, amortizing HBM latency across the group (a 1-roi-per-
     # step grid serializes fetch->compute->fetch and measured ~10 ms for
-    # 1024 train rois; grouped fetches overlap).  Small-class rois (the
-    # majority — see _prep) copy only the (ts, ts) corner; cells beyond it
-    # hold stale finite scratch that every interpolation weight zeroes —
-    # which needs the scratch to START finite: uninitialized VMEM can hold
-    # NaN and 0 * NaN poisons the matmul, so step 0 memsets all windows
-    # once (later steps inherit real features or these zeros).
+    # 1024 train rois; grouped fetches overlap).  Each roi copies only its
+    # CLASS window corner (see _prep); cells beyond it hold stale finite
+    # scratch that every interpolation weight zeroes — which needs the
+    # scratch to START finite: uninitialized VMEM can hold NaN and 0 * NaN
+    # poisons the matmul, so step 0 memsets all windows once (later steps
+    # inherit real features or these zeros).
     @pl.when(pl.program_id(0) == 0)
     def _():
         for g in range(group):
             win[g] = jnp.zeros((t, t, win.shape[-1]), win.dtype)
 
-    # (Cells a DMA never reaches — undersized levels, small-class corners —
+    # (Cells a DMA never reaches — undersized levels, class corners —
     # need no per-step re-zeroing: the extent/corner masking in the interp
     # matrices gives them exactly-zero weight, and the step-0 memset keeps
     # them finite for the whole grid.)
+    cls_col = 8 + 2 * len(classes)
     for phase in ("start", "wait"):
         for g in range(group):
             level = roi_ref[g, 0, 6].astype(jnp.int32)
-            oy = roi_ref[g, 0, 7].astype(jnp.int32)
-            ox = pl.multiple_of(roi_ref[g, 0, 8].astype(jnp.int32), 8)
-            bi = roi_ref[g, 0, 9].astype(jnp.int32)
-            small = roi_ref[g, 0, 10] > 0.5
-            oy_s = roi_ref[g, 0, 11].astype(jnp.int32)
-            ox_s = pl.multiple_of(roi_ref[g, 0, 12].astype(jnp.int32), 8)
-            for i, f in enumerate(feat_refs):
-                th = min(t, f.shape[1])
-                tw = min(t, f.shape[2])
-                ths = min(ts, th)
-                tws = min(ts, tw)
+            bi = roi_ref[g, 0, 7].astype(jnp.int32)
+            cls = roi_ref[g, 0, cls_col].astype(jnp.int32)
+            for ci, (ty, tx) in enumerate(classes):
+                oy_c = roi_ref[g, 0, 8 + 2 * ci].astype(jnp.int32)
+                ox_c = pl.multiple_of(
+                    roi_ref[g, 0, 9 + 2 * ci].astype(jnp.int32), 8
+                )
+                for i, f in enumerate(feat_refs):
+                    th = min(ty, f.shape[1])
+                    tw = min(tx, f.shape[2])
 
-                @pl.when((level == i) & jnp.logical_not(small))
-                def _(g=g, f=f, th=th, tw=tw, oy=oy, ox=ox, bi=bi,
-                      phase=phase):
-                    getattr(pltpu.make_async_copy(
-                        f.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                        win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
-                        sem.at[g],
-                    ), phase)()
+                    @pl.when((level == i) & (cls == ci))
+                    def _(g=g, f=f, th=th, tw=tw, oy_c=oy_c, ox_c=ox_c,
+                          bi=bi, phase=phase):
+                        getattr(pltpu.make_async_copy(
+                            f.at[bi, pl.ds(oy_c, th), pl.ds(ox_c, tw), :],
+                            win.at[g, pl.ds(0, th), pl.ds(0, tw), :],
+                            sem.at[g],
+                        ), phase)()
 
-                @pl.when((level == i) & small)
-                def _(g=g, f=f, ths=ths, tws=tws, oy_s=oy_s, ox_s=ox_s,
-                      bi=bi, phase=phase):
-                    getattr(pltpu.make_async_copy(
-                        f.at[bi, pl.ds(oy_s, ths), pl.ds(ox_s, tws), :],
-                        win.at[g, pl.ds(0, ths), pl.ds(0, tws), :],
-                        sem.at[g],
-                    ), phase)()
-
-    # Phase 2: interpolate each roi's window (two small matmuls each, with
-    # the sr x sr bin mean baked into the interpolation matrices — see
-    # _interp_matrix_avg; the explicit post-matmul mean doubled the second
-    # matmul's N for nothing).
+    # Phase 2: interpolate each roi's window — per CLASS, at the class's
+    # static (Ty, Tx) widths: the matmul cost tracks Ty*Tx*C exactly like
+    # the DMA does, so a (16, 24)-class roi runs 1/6 the full-window
+    # matmul FLOPs, not just 1/6 the copy bytes.  The sr x sr bin mean is
+    # baked into the interpolation matrices (see _interp_matrix_avg).
     s, sr = output_size, sampling_ratio
     c = win.shape[-1]
     for g in range(group):
@@ -184,36 +190,41 @@ def _kernel(
         bin_h = roi_ref[g, 0, 3]
         hl = roi_ref[g, 0, 4]
         wl = roi_ref[g, 0, 5]
-        oy = roi_ref[g, 0, 7].astype(jnp.int32)
-        ox = roi_ref[g, 0, 8].astype(jnp.int32)
-        # The interpolation origin must match whichever window was DMA'd.
-        small = roi_ref[g, 0, 10] > 0.5
-        oy = jnp.where(small, roi_ref[g, 0, 11].astype(jnp.int32), oy)
-        ox = jnp.where(small, roi_ref[g, 0, 12].astype(jnp.int32), ox)
+        cls = roi_ref[g, 0, cls_col].astype(jnp.int32)
+        for ci, (ty, tx) in enumerate(classes):
+            # The interpolation origin must match whichever class window
+            # was DMA'd; each roi matches exactly one class branch, so
+            # out_ref[g] is written exactly once.
+            oy_c = roi_ref[g, 0, 8 + 2 * ci].astype(jnp.int32)
+            ox_c = roi_ref[g, 0, 9 + 2 * ci].astype(jnp.int32)
 
-        wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)      # (S, T)
-        wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox, t)      # (S, T)
+            @pl.when(cls == ci)
+            def _(g=g, ty=ty, tx=tx, oy_c=oy_c, ox_c=ox_c):
+                wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy_c, ty)
+                wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox_c, tx)
 
-        # rows: (S, T) @ (T, T*C) -> (S, T, C).
-        # HIGHEST precision: the interpolation weights are exact f32;
-        # default (bf16 MXU passes) would quantize sample positions ~2^-8.
-        # A 2-pass split-weight variant was tried in r3 and REVERTED: with
-        # single-tile M the matmuls are padding-bound, not pass-bound —
-        # the split's extra per-step casts made the forward ~2 ms SLOWER
-        # at train shapes (9.4 -> 11.6 ms).
-        rows = jax.lax.dot_general(
-            wy, win[g].astype(jnp.float32).reshape(t, t * c),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).reshape(s, t, c)
-        qpc = jax.lax.dot_general(
-            wx, rows,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                         # (Sx, Sy, C)
-        out_ref[g] = jnp.swapaxes(qpc, 0, 1).astype(out_ref.dtype)
+                # rows: (S, Ty) @ (Ty, Tx*C) -> (S, Tx, C).
+                # HIGHEST precision: the interpolation weights are exact
+                # f32; default (bf16 MXU passes) would quantize sample
+                # positions ~2^-8.  A 2-pass split-weight variant was
+                # tried in r3 and REVERTED: with single-tile M the matmuls
+                # are padding-bound, not pass-bound — the split's extra
+                # per-step casts made the forward ~2 ms SLOWER at train
+                # shapes (9.4 -> 11.6 ms).
+                sub = win[g, pl.ds(0, ty), pl.ds(0, tx), :]
+                rows = jax.lax.dot_general(
+                    wy, sub.astype(jnp.float32).reshape(ty, tx * c),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                ).reshape(s, tx, c)
+                qpc = jax.lax.dot_general(
+                    wx, rows,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )                                                 # (Sx, Sy, C)
+                out_ref[g] = jnp.swapaxes(qpc, 0, 1).astype(out_ref.dtype)
 
 
 def _prep(feature_pyramid, rois, output_size, window):
@@ -263,51 +274,57 @@ def _prep(feature_pyramid, rois, output_size, window):
     rh = jnp.maximum(flat[:, 3] * scale - y1, 1.0)
     roi_geom = [x1, y1, rw / output_size, rh / output_size, hs, ws]
 
-    # Window origin: one cell of bilinear margin, clamped into the map.
-    # ox additionally floors to a multiple of 8 — Mosaic requires provable
-    # sublane alignment for HBM slices in the tiled (second-to-last) dim;
-    # the up-to-7-cell loss is budgeted in max_extent_cells above.
-    oy = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - t, 0)).astype(jnp.int32)
-    ox = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - t, 0)).astype(jnp.int32)
-    ox = (ox // 8) * 8
     bidx = jnp.repeat(jnp.arange(b, dtype=jnp.int32), r_per)
 
-    # Small-window class: the kernel is DMA-bound (cost tracks T^2*C — the
-    # window bytes; measured 40.2 ms at T=48 vs 17.3 at T=32, eval shapes),
-    # and MOST rois fit a far smaller window than the worst case T must
-    # cover — the FPN level assignment targets ~7-20 cells of extent.
-    # Rois whose every nonzero tap fits a T_S window anchored at the
-    # T_S-clamped origin DMA only that corner; cells beyond it hold stale
-    # scratch with exactly-zero interpolation weight (finite garbage x 0).
-    ts = min(SMALL_WINDOW, t)
-    oy_s = jnp.clip(jnp.floor(y1) - 1, 0, jnp.maximum(hs - ts, 0)).astype(jnp.int32)
-    ox_s = jnp.clip(jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - ts, 0)).astype(jnp.int32)
-    ox_s = (ox_s // 8) * 8
+    # Window classes (smallest first; the last is the (t, t) fallback —
+    # see window_classes).  Per class: origin with one cell of bilinear
+    # margin, clamped into the map; ox floors to a multiple of 8 (Mosaic
+    # requires provable sublane alignment for HBM slices in the tiled
+    # second-to-last dim; the up-to-7-cell loss is budgeted both in
+    # max_extent_cells and in each class's fit test).  A roi takes the
+    # SMALLEST class whose every nonzero tap fits the class window at its
+    # clamped origin; cells beyond the DMA'd corner hold stale scratch
+    # with exactly-zero interpolation weight (finite garbage x 0).
+    #
     # Highest cell any sample can tap: floor of the largest clipped sample
     # coordinate, +1 for the second bilinear tap, +1 more as f32 slack (the
     # kernel recomputes coords as y1 + k*(rh/S), which can exceed y1 + rh
     # by an ULP — the slack makes the bound robustly conservative).
+    classes = window_classes(t)
     y_hi = jnp.minimum(
         jnp.floor(jnp.clip(y1 + rh, 0.0, hs - 1.0)) + 2.0, hs - 1.0
     )
     x_hi = jnp.minimum(
         jnp.floor(jnp.clip(x1 + rw, 0.0, ws - 1.0)) + 2.0, ws - 1.0
     )
-    small = (
-        (y_hi - oy_s.astype(jnp.float32) <= ts - 1)
-        & (x_hi - ox_s.astype(jnp.float32) <= ts - 1)
-    )
+    origin_cols = []
+    cls = jnp.full(x1.shape, len(classes) - 1, jnp.int32)
+    for ci in reversed(range(len(classes))):
+        ty, tx = classes[ci]
+        oy_c = jnp.clip(
+            jnp.floor(y1) - 1, 0, jnp.maximum(hs - ty, 0)
+        ).astype(jnp.int32)
+        ox_c = jnp.clip(
+            jnp.floor(x1) - 1, 0, jnp.maximum(ws_pad - tx, 0)
+        ).astype(jnp.int32)
+        ox_c = (ox_c // 8) * 8
+        if ci < len(classes) - 1:
+            fits = (
+                (y_hi - oy_c.astype(jnp.float32) <= ty - 1)
+                & (x_hi - ox_c.astype(jnp.float32) <= tx - 1)
+            )
+            cls = jnp.where(fits, ci, cls)
+        origin_cols = [oy_c.astype(jnp.float32), ox_c.astype(jnp.float32)] + origin_cols
 
     # Indices ride the same f32 table as the geometry (exact for values
     # < 2^24; feature maps are nowhere near that) — see _kernel docstring.
     roi_params = jnp.stack(
         roi_geom
-        + [level_idx.astype(jnp.float32), oy.astype(jnp.float32),
-           ox.astype(jnp.float32), bidx.astype(jnp.float32),
-           small.astype(jnp.float32), oy_s.astype(jnp.float32),
-           ox_s.astype(jnp.float32)],
+        + [level_idx.astype(jnp.float32), bidx.astype(jnp.float32)]
+        + origin_cols
+        + [cls.astype(jnp.float32)],
         axis=1,
-    ).astype(jnp.float32)[:, None, :]                          # (N, 1, 13)
+    ).astype(jnp.float32)[:, None, :]              # (N, 1, 9 + 2K)
     # 3-D so the SMEM block's last two dims equal the array's (Mosaic's
     # block-shape divisibility rule exempts full-extent dims).
     return levels, feats, ws_true, roi_params, b, r_per, batched
@@ -351,9 +368,10 @@ def multilevel_roi_align_pallas(
     budget = max(1, (12 * 1024 * 1024) // (t * t * c * itemsize))
     grp = max(1, min(group, budget, n))
     n_pad = -n % grp
+    nf = roi_params.shape[-1]
     if n_pad:
         roi_params = jnp.concatenate(
-            [roi_params, jnp.broadcast_to(roi_params[:1], (n_pad, 1, 13))]
+            [roi_params, jnp.broadcast_to(roi_params[:1], (n_pad, 1, nf))]
         )
 
     kernel = functools.partial(
@@ -369,7 +387,7 @@ def multilevel_roi_align_pallas(
         grid=((n + n_pad) // grp,),
         in_specs=[
             pl.BlockSpec(
-                (grp, 1, 13), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+                (grp, 1, nf), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
             )
         ] + [pl.BlockSpec(memory_space=pl.ANY) for _ in levels],
         out_specs=pl.BlockSpec(
@@ -391,7 +409,7 @@ def multilevel_roi_align_pallas(
 
 
 def _bwd_kernel(
-    roi_ref,       # SMEM (1, 1, 13) f32 — same 13 fields as the forward.
+    roi_ref,       # SMEM (1, 1, 9+2K) f32 — same fields as the forward.
     g_ref,         # VMEM (1, S, S, C) — cotangent of this roi's pooled out.
     *rest,
     num_levels: int,
@@ -424,33 +442,24 @@ def _bwd_kernel(
     win2 = rest[2 * num_levels]
     sem = rest[2 * num_levels + 1]
 
+    classes = window_classes(t)
+    cls_col = 8 + 2 * len(classes)
     level = roi_ref[0, 0, 6].astype(jnp.int32)
-    oy = roi_ref[0, 0, 7].astype(jnp.int32)
-    ox = pl.multiple_of(roi_ref[0, 0, 8].astype(jnp.int32), 8)
-    bi = roi_ref[0, 0, 9].astype(jnp.int32)
+    bi = roi_ref[0, 0, 7].astype(jnp.int32)
     x1 = roi_ref[0, 0, 0]
     y1 = roi_ref[0, 0, 1]
     bin_w = roi_ref[0, 0, 2]
     bin_h = roi_ref[0, 0, 3]
     hl = roi_ref[0, 0, 4]
     wl = roi_ref[0, 0, 5]
-    # Small-window class (see _prep/_kernel): the RMW traffic — 2x window
-    # bytes per roi — shrinks the same way the forward DMA does.  The
-    # interp origins must match the window actually read back.
-    small = roi_ref[0, 0, 10] > 0.5
-    ts = min(SMALL_WINDOW, t)
-    oy = jnp.where(small, roi_ref[0, 0, 11].astype(jnp.int32), oy)
-    # Re-annotate after the select: both branches are 8-aligned but Mosaic
-    # cannot prove it through a where, and the RMW HBM slice requires it.
-    ox = pl.multiple_of(
-        jnp.where(small, roi_ref[0, 0, 12].astype(jnp.int32), ox), 8
-    )
-
+    # Window classes (see _prep/_kernel): the RMW traffic — 2x window
+    # bytes per roi — AND the transposed matmuls shrink with the class,
+    # exactly like the forward.  The interp origins must match the window
+    # actually read back.
+    cls = roi_ref[0, 0, cls_col].astype(jnp.int32)
     s, sr = output_size, sampling_ratio
-    wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy, t)       # (S, T)
-    wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox, t)       # (S, T)
-
     c = win2.shape[-1]
+
     # d_out (S_y, S_x, C) -> d_qpc (S_x, S_y, C): just the transpose of the
     # forward's (x, y) -> (y, x) swap — the sr x sr subsample mean lives in
     # the averaged interpolation matrices (forward and backward MUST use
@@ -460,20 +469,19 @@ def _bwd_kernel(
     g = g_ref[0]                                               # (S, S, C)
     d_qpc = jnp.swapaxes(g, 0, 1)                              # (S_x, S_y, C)
 
-    # d_rows_T[tx, sy, c] = sum_sx wx[sx, tx] * d_qpc[sx, sy, c] — the
-    # SMALL matmul (N = S*C), against the native cotangent.
-    # Precision: bf16 cotangents (the train graph) take DEFAULT — one MXU
-    # pass with f32 accumulation.  The operands' information content is
-    # already bf16 (the cotangent arrives in the graph's compute dtype), so
-    # truncating the exact-f32 weights costs ~2^-8 relative.  The SECOND
-    # dot additionally truncates the f32 intermediate d_rows_t: each of its
-    # rows is a <=2-tap combination (weights summing <=1) of bf16-valued
-    # cotangent entries, so that rounding is one more independent ~2^-8
-    # relative error — no amplification, still below the cotangent's own
-    # quantization and strictly tighter than the bf16-ACCUMULATING XLA
-    # scatter-add this kernel replaced (hundreds of bf16 += per P2 cell).
-    # On-chip check (the off-TPU interpret tests can't see MXU truncation):
-    # max |pallas - xla-autodiff| feature-grad diff at R101 train shapes is
+    # Precision of the two transposed matmuls: bf16 cotangents (the train
+    # graph) take DEFAULT — one MXU pass with f32 accumulation.  The
+    # operands' information content is already bf16 (the cotangent arrives
+    # in the graph's compute dtype), so truncating the exact-f32 weights
+    # costs ~2^-8 relative.  The SECOND dot additionally truncates the f32
+    # intermediate d_rows_t: each of its rows is a <=2-tap combination
+    # (weights summing <=1) of bf16-valued cotangent entries, so that
+    # rounding is one more independent ~2^-8 relative error — no
+    # amplification, still below the cotangent's own quantization and
+    # strictly tighter than the bf16-ACCUMULATING XLA scatter-add this
+    # kernel replaced (hundreds of bf16 += per P2 cell).  On-chip check
+    # (the off-TPU interpret tests can't see MXU truncation): max
+    # |pallas - xla-autodiff| feature-grad diff at R101 train shapes is
     # within bf16 output granularity.  Measured 10.7 -> 6.1 ms at R101
     # train shapes vs HIGHEST.  f32 cotangents (CPU-recipe tests, golden
     # paths) keep the exact HIGHEST dot.  The FORWARD stays HIGHEST always:
@@ -485,46 +493,56 @@ def _bwd_kernel(
         if g.dtype == jnp.bfloat16
         else jax.lax.Precision.HIGHEST
     )
-    d_rows_t = jax.lax.dot_general(
-        wx, d_qpc.astype(jnp.float32).reshape(s, s * c),
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    ).reshape(t, s, c)                                         # (Tx, Sy, C)
-    d_window = jax.lax.dot_general(
-        wy, d_rows_t,
-        dimension_numbers=(((0,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=prec,
-    )                                                          # (Ty, Tx, C)
+    for ci, (ty, tx) in enumerate(classes):
+        oy_c = roi_ref[0, 0, 8 + 2 * ci].astype(jnp.int32)
+        ox_c = pl.multiple_of(roi_ref[0, 0, 9 + 2 * ci].astype(jnp.int32), 8)
 
-    for i, gl in enumerate(out_refs):
-        for is_small in (False, True):
-            th = min(ts if is_small else t, gl.shape[1])
-            tw = min(ts if is_small else t, gl.shape[2])
-            cond = (level == i) & (small if is_small else jnp.logical_not(small))
+        @pl.when(cls == ci)
+        def _(ty=ty, tx=tx, oy_c=oy_c, ox_c=ox_c):
+            wy = _interp_matrix_avg(y1, bin_h, s, sr, hl, oy_c, ty)  # (S, Ty)
+            wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox_c, tx)  # (S, Tx)
+            # d_rows_T[tx, sy, c] = sum_sx wx[sx, tx] * d_qpc[sx, sy, c] —
+            # the SMALL matmul (N = S*C), against the native cotangent.
+            d_rows_t = jax.lax.dot_general(
+                wx, d_qpc.astype(jnp.float32).reshape(s, s * c),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=prec,
+            ).reshape(tx, s, c)                                # (Tx, Sy, C)
+            d_window = jax.lax.dot_general(
+                wy, d_rows_t,
+                dimension_numbers=(((0,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=prec,
+            )                                                  # (Ty, Tx, C)
 
-            @pl.when(cond)
-            def _(gl=gl, th=th, tw=tw):
-                # Read-modify-write of the roi's window slice.  Taps beyond
-                # the level's true extent (and, for the small class, beyond
-                # the ts corner) carry zero weight in the interp matrices,
-                # so adding the [:th, :tw] corner is exact.
-                rd = pltpu.make_async_copy(
-                    gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                    win2.at[pl.ds(0, th), pl.ds(0, tw), :],
-                    sem,
-                )
-                rd.start()
-                rd.wait()
-                win2[:th, :tw, :] = win2[:th, :tw, :] + d_window[:th, :tw, :]
-                wr = pltpu.make_async_copy(
-                    win2.at[pl.ds(0, th), pl.ds(0, tw), :],
-                    gl.at[bi, pl.ds(oy, th), pl.ds(ox, tw), :],
-                    sem,
-                )
-                wr.start()
-                wr.wait()
+            for i, gl in enumerate(out_refs):
+                th = min(ty, gl.shape[1])
+                tw = min(tx, gl.shape[2])
+
+                @pl.when(level == i)
+                def _(gl=gl, th=th, tw=tw, d_window=d_window):
+                    # Read-modify-write of the roi's class-window slice.
+                    # Taps beyond the level's true extent (and beyond the
+                    # class corner) carry zero weight in the interp
+                    # matrices, so adding the [:th, :tw] corner is exact.
+                    rd = pltpu.make_async_copy(
+                        gl.at[bi, pl.ds(oy_c, th), pl.ds(ox_c, tw), :],
+                        win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                        sem,
+                    )
+                    rd.start()
+                    rd.wait()
+                    win2[:th, :tw, :] = (
+                        win2[:th, :tw, :] + d_window[:th, :tw, :]
+                    )
+                    wr = pltpu.make_async_copy(
+                        win2.at[pl.ds(0, th), pl.ds(0, tw), :],
+                        gl.at[bi, pl.ds(oy_c, th), pl.ds(ox_c, tw), :],
+                        sem,
+                    )
+                    wr.start()
+                    wr.wait()
 
 
 @functools.partial(
@@ -567,7 +585,8 @@ def multilevel_roi_align_bwd_pallas(
         grid=(n,),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 13), lambda r: (r, 0, 0), memory_space=pltpu.SMEM
+                (1, 1, roi_params.shape[-1]), lambda r: (r, 0, 0),
+                memory_space=pltpu.SMEM,
             ),
             pl.BlockSpec(
                 (1, s, s, c), lambda r: (r, 0, 0, 0), memory_space=pltpu.VMEM

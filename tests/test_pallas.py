@@ -110,17 +110,17 @@ class TestPallasRoiAlign:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
     def test_window_size_classes_match_xla(self, rng):
-        """Small-class rois (fit the SMALL_WINDOW corner) and large-class
-        rois (canvas-scale, clamped at the coarsest level) share one launch
-        and both match the oracle — covering the per-roi conditional DMA +
-        origin-select path and the stale-cells-are-zero-weighted argument."""
-        from mx_rcnn_tpu.ops.pallas.roi_align import SMALL_WINDOW
+        """Rois spanning the smallest and the full window classes share one
+        launch and all match the oracle — covering the per-roi conditional
+        DMA + origin-select path and the stale-cells-are-zero-weighted
+        argument."""
+        from mx_rcnn_tpu.ops.pallas.roi_align import window_classes
 
         # Coarsest level = P3 of a 512 canvas (64-cell map), so a ~260 px
-        # roi clamps there at ~32.5 cells of extent: beyond the
-        # SMALL_WINDOW budget (large class) but within the 48-window's
-        # exact range.  Smaller pyramids cannot produce a large-class roi
-        # at all (every map fits the 32-corner whole).
+        # roi clamps there at ~32.5 cells of extent: beyond every small
+        # class budget (full-window class) but within the 48-window's
+        # exact range.  Smaller pyramids cannot produce a full-class roi
+        # at all (every map fits a small corner whole).
         canvas = 512
         pyr = _pyramid(rng, canvas, levels=(2, 3))
         small = np.array(_random_rois(rng, 24, canvas))
@@ -135,10 +135,22 @@ class TestPallasRoiAlign:
         # The class split must actually exercise BOTH branches.
         from mx_rcnn_tpu.ops.pallas.roi_align import _prep
 
+        # Mid-extent rois (~20 cells at P2) so the MIDDLE class branch is
+        # exercised too, not just the smallest and the fallback.
+        mid = np.asarray(
+            [[40.0, 40.0, 120.0, 118.0], [300.0, 200.0, 383.0, 270.0]] * 2,
+            np.float32,
+        )
+        rois = jnp.asarray(
+            np.concatenate([np.asarray(rois), mid]), jnp.float32
+        )
         _, _, _, params, _, _, _ = _prep(pyr, rois, 7, 48)
-        flags = np.asarray(params[:, 0, 10])
-        assert flags.min() == 0.0 and flags.max() == 1.0
-        assert SMALL_WINDOW < 48
+        cls = np.asarray(params[:, 0, -1])
+        n_classes = len(window_classes(48))
+        assert n_classes >= 3
+        # EVERY class branch (DMA origin + matmul width + interp origin)
+        # must be hit — a middle-class-only bug would otherwise stay green.
+        assert len(np.unique(cls)) == n_classes, np.unique(cls)
         ref = multilevel_roi_align(pyr, rois, output_size=7, sampling_ratio=2)
         out = multilevel_roi_align_pallas(
             pyr, rois, output_size=7, sampling_ratio=2, interpret=True
@@ -146,12 +158,12 @@ class TestPallasRoiAlign:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
     def test_window_size_classes_bwd_matches_xla_grad(self, rng):
-        """The BACKWARD's two-class RMW path on the same mixed small/large
-        roi set as the forward test above: the origin re-select and the
-        is_small branch pair in _bwd_kernel must scatter gradients into the
-        window the class actually reads, or recipe-canvas (large-class)
-        gradients silently land in the wrong cells while every tiny-canvas
-        test stays green."""
+        """The BACKWARD's per-class RMW path on the same mixed roi set as
+        the forward test above: the origin re-select and the class branches
+        in _bwd_kernel must scatter gradients into the window the class
+        actually reads, or recipe-canvas (full-class) gradients silently
+        land in the wrong cells while every tiny-canvas test stays
+        green."""
         import jax
 
         from mx_rcnn_tpu.ops.pallas import roi_align as pra
@@ -168,9 +180,18 @@ class TestPallasRoiAlign:
             np.float32,
         )
         rois = jnp.asarray(np.concatenate([small, giant]), jnp.float32)
+        mid = np.asarray(
+            [[40.0, 40.0, 120.0, 118.0], [300.0, 200.0, 383.0, 270.0]],
+            np.float32,
+        )
+        rois = jnp.asarray(
+            np.concatenate([np.asarray(rois), mid]), jnp.float32
+        )
         _, _, _, params, _, _, _ = _prep(pyr, rois, 7, 48)
-        flags = np.asarray(params[:, 0, 10])
-        assert flags.min() == 0.0 and flags.max() == 1.0
+        from mx_rcnn_tpu.ops.pallas.roi_align import window_classes
+
+        cls = np.asarray(params[:, 0, -1])
+        assert len(np.unique(cls)) == len(window_classes(48)), np.unique(cls)
 
         def loss_ref(p):
             return (
