@@ -89,6 +89,17 @@ class RPNConfig:
     nms_threshold: float = 0.7
     min_size: float = 0.0
     loss_weight: float = 1.0
+    # Pre-NMS top-k selection over the anchor scores.  "exact" =
+    # lax.top_k (full sort network); "approx" = lax.approx_max_k (the
+    # TPU PartialReduce op) at ``topk_recall`` expected recall of the
+    # true top-k.  The k'th-ranked RPN scores are deep in the sigmoid
+    # tail, so the ~(1-recall) swapped candidates are low-objectness
+    # boxes NMS/top-post would drop anyway — but "exact" stays the
+    # default for reference parity; measured A/B in BASELINE.md.  Off
+    # TPU, approx_max_k lowers to a full sort (exact), so CPU tests and
+    # goldens see identical numbers either way.
+    topk_impl: str = "exact"
+    topk_recall: float = 0.95
 
 
 @dataclass(frozen=True)
@@ -139,6 +150,26 @@ class TestConfig:
     score_threshold: float = 0.05
     nms_threshold: float = 0.5  # per-class NMS (reference uses 0.3 for VOC)
     max_detections: int = 100
+    # Postprocess NMS structure.  "per_class" replays the reference's
+    # per-class loop exactly (one NMS fixed point per foreground class,
+    # vmapped — C-1 passes of per_class_k boxes per image).  "fused" —
+    # the default — takes the global top-``fused_top_k`` (roi, class)
+    # candidates by score and runs ONE class-offset NMS over them
+    # (ops/nms.py::batched_nms); per-class results are identical whenever
+    # no class overflows the per-class cap and the union of
+    # above-threshold candidates fits ``fused_top_k`` (tested), which
+    # real images satisfy — only the pre-NMS candidate cap moves from
+    # per-class (2*max_detections each) to global.  When the global cap
+    # DOES bind, the dropped candidates are the score-ranked-worst
+    # pre-NMS; under heavy suppression one of them could have survived
+    # its class NMS into the final set, so binding-cap outputs can
+    # differ (use "per_class" for exact reference replay there).  TPU
+    # rationale: 80 vmapped while-loops run
+    # every class lane until the slowest converges; one fused pass
+    # converges once.  Measured (BASELINE.md): R50-FPN eval batch-8
+    # 82.1 -> 94.9 img/s/chip.
+    nms_mode: str = "fused"
+    fused_top_k: int = 1000
 
 
 @dataclass(frozen=True)

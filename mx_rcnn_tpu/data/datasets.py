@@ -48,19 +48,51 @@ class SyntheticDataset:
         max_objects: int = 4,
         seed: int = 0,
         dtype: str = "float32",
+        palette: str = "classic",
     ) -> None:
         """``dtype="uint8"`` rounds the rendered pixels to uint8 — the
         loader then ships them raw and normalizes in-graph, exactly like a
-        disk-backed dataset (float32 keeps the historical golden pixels)."""
+        disk-backed dataset (float32 keeps the historical golden pixels).
+
+        ``palette`` picks the class appearance model.  "classic" is the
+        historical linear color ramp — bit-stable (the overfit goldens
+        were recorded on it) but saturating above class ~8, so an
+        80-class set is mostly indistinguishable.  "wheel" assigns every
+        class a distinct golden-ratio hue plus a (stripe period,
+        orientation, value-band) texture combo, all in-gamut — use it for
+        many-class runs (tools/soak.py) where absolute AP should measure
+        the DETECTOR, not the renderer's color collisions."""
+        if palette not in ("classic", "wheel"):
+            raise ValueError(f"palette must be 'classic' or 'wheel', got {palette!r}")
         self.num_images = num_images
         self.image_hw = image_hw
         self.num_classes = num_classes  # incl. background 0
         self.max_objects = max_objects
         self.seed = seed
         self.dtype = dtype
+        self.palette = palette
         self.classes = ("__background__",) + tuple(
             f"shape{c}" for c in range(1, num_classes)
         )
+
+    @staticmethod
+    def class_style(cls: int) -> tuple[np.ndarray, int, int]:
+        """Deterministic distinct (color, stripe period, orientation) for
+        the "wheel" palette.  Hue walks the golden-ratio sequence (low
+        discrepancy — 80 classes stay well separated on the wheel); the
+        texture tuple (period 3..8, orientation of 4, value band of 2)
+        is injective over 48 classes, so any hue near-collision still
+        differs in texture."""
+        import colorsys
+
+        hue = (cls * 0.61803398875) % 1.0
+        sat = 0.6 + 0.35 * (cls % 2)
+        val = (160.0 + 80.0 * ((cls // 24) % 2)) / 255.0
+        r, g, b = colorsys.hsv_to_rgb(hue, sat, val)
+        color = np.asarray([r, g, b], np.float32) * 255.0
+        period = 3 + cls % 6
+        orient = (cls // 6) % 4
+        return color, period, orient
 
     def _render(self, idx: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         rng = np.random.RandomState(self.seed * 100003 + idx)
@@ -77,13 +109,24 @@ class SyntheticDataset:
             # Class-specific color + texture: stripes along an axis whose
             # period encodes the class.
             yy, xx = np.mgrid[y1 : y1 + bh, x1 : x1 + bw]
-            stripe = ((xx // (cls + 1) + yy // (cls + 1)) % 2).astype(np.float32)
-            color = np.array(
-                [80 + 40 * cls, 255 - 35 * cls, 120 + 25 * (cls % 3)], np.float32
-            )
-            img[y1 : y1 + bh, x1 : x1 + bw] = (
-                color * (0.6 + 0.4 * stripe[..., None])
-            )
+            if self.palette == "wheel":
+                color, period, orient = self.class_style(cls)
+                coord = (xx, yy, xx + yy, xx - yy)[orient]
+                stripe = ((coord // period) % 2).astype(np.float32)
+                img[y1 : y1 + bh, x1 : x1 + bw] = (
+                    color * (0.55 + 0.45 * stripe[..., None])
+                )
+            else:
+                stripe = ((xx // (cls + 1) + yy // (cls + 1)) % 2).astype(
+                    np.float32
+                )
+                color = np.array(
+                    [80 + 40 * cls, 255 - 35 * cls, 120 + 25 * (cls % 3)],
+                    np.float32,
+                )
+                img[y1 : y1 + bh, x1 : x1 + bw] = (
+                    color * (0.6 + 0.4 * stripe[..., None])
+                )
             boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
             classes.append(cls)
         if self.dtype == "uint8":

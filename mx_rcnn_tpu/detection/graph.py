@@ -33,7 +33,7 @@ from mx_rcnn_tpu.geometry import (
     weighted_smooth_l1,
 )
 from mx_rcnn_tpu.ops import assign_anchors, generate_proposals, roi_align, sample_rois
-from mx_rcnn_tpu.ops.nms import nms_indices
+from mx_rcnn_tpu.ops.nms import batched_nms, nms_indices
 from mx_rcnn_tpu.ops.pallas.roi_align import (
     POOL_WINDOW,
     multilevel_roi_align_fast,
@@ -190,11 +190,13 @@ def _propose_one(cfg: ModelConfig, train: bool):
                 s, d, a, hw[0], hw[1],
                 pre_nms_top_n=pre, post_nms_top_n=post,
                 nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
+                topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
             )
         return generate_fpn_proposals(
             level_scores, level_deltas, level_anchor, hw[0], hw[1],
             pre_nms_top_n=pre, post_nms_top_n=post,
             nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
+            topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
         )
 
     return single
@@ -629,8 +631,17 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch,
     cls_prob = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, num_classes)
     box_deltas = box_deltas.reshape(b, r, -1, 4)
 
+    if cfg.test.nms_mode == "fused":
+        post_one = _postprocess_one_fused
+    elif cfg.test.nms_mode == "per_class":
+        post_one = _postprocess_one
+    else:
+        raise ValueError(
+            f"test.nms_mode must be 'per_class' or 'fused', "
+            f"got {cfg.test.nms_mode!r}"
+        )
     post = jax.vmap(
-        lambda rois, rv, probs, deltas, hw: _postprocess_one(
+        lambda rois, rv, probs, deltas, hw: post_one(
             cfg, rois, rv, probs, deltas, hw
         )
     )(props.rois, props.valid, cls_prob, box_deltas, batch.image_hw)
@@ -723,5 +734,61 @@ def _postprocess_one(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw):
         jnp.take(flat_b, top_i, axis=0) * valid[:, None],
         jnp.where(valid, top_s, 0.0),
         jnp.where(valid, jnp.take(flat_c, top_i), 0).astype(jnp.int32),
+        valid,
+    )
+
+
+def _postprocess_one_fused(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw):
+    """Fused postprocess: global top-K candidates, ONE class-offset NMS.
+
+    Same decode/threshold/suppression math as :func:`_postprocess_one`,
+    restructured for the TPU: instead of C-1 per-class passes (each a
+    top-k plus an NMS fixed point that vmap runs until the slowest class
+    converges), score-rank ALL (roi, class) pairs once, keep the top
+    ``cfg.test.fused_top_k``, decode only those, and suppress with one
+    ``batched_nms`` (boxes translated to per-class disjoint regions, so
+    one pass equals independent per-class NMS).  Equal output whenever no
+    per-class/global candidate cap binds — the caps are the only
+    semantic difference, and both are far above the reference's
+    max-100-detections regime.
+    """
+    num_classes = cfg.num_classes
+    r = rois.shape[0]
+    d_out = cfg.test.max_detections
+    fg = num_classes - 1
+    k = min(r * fg, cfg.test.fused_top_k)
+
+    sc = jnp.where(
+        roi_valid[:, None] & (probs[:, 1:] >= cfg.test.score_threshold),
+        probs[:, 1:],
+        -jnp.inf,
+    )                                                   # (R, C-1)
+    top_s, top_i = lax.top_k(sc.reshape(-1), k)         # flat id = roi*fg + (c-1)
+    roi_i = top_i // fg
+    cls = top_i % fg + 1                                # 1-based fg class
+
+    cand_rois = jnp.take(rois, roi_i, axis=0)
+    if cfg.rcnn.class_agnostic:
+        delta_sel = deltas[roi_i, 0, :]
+    else:
+        delta_sel = deltas[roi_i, cls, :]
+    boxes = decode_boxes(delta_sel, cand_rois, weights=cfg.rcnn.bbox_weights)
+    boxes = clip_boxes(boxes, hw[0], hw[1])
+
+    cand_valid = jnp.isfinite(top_s)
+    keep = batched_nms(
+        boxes, top_s, cls, cfg.test.nms_threshold, valid=cand_valid
+    )
+    kept_s = jnp.where(keep, top_s, -jnp.inf)
+    out_s, out_i = lax.top_k(kept_s, min(d_out, k))
+    if k < d_out:
+        pad = d_out - k
+        out_s = jnp.concatenate([out_s, jnp.full(pad, -jnp.inf, out_s.dtype)])
+        out_i = jnp.concatenate([out_i, jnp.zeros(pad, out_i.dtype)])
+    valid = jnp.isfinite(out_s)
+    return (
+        jnp.take(boxes, out_i, axis=0) * valid[:, None],
+        jnp.where(valid, out_s, 0.0),
+        jnp.where(valid, jnp.take(cls, out_i), 0).astype(jnp.int32),
         valid,
     )

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -35,6 +36,8 @@ def generate_proposals(
     post_nms_top_n: int = 300,
     nms_threshold: float = 0.7,
     min_size: float = 0.0,
+    topk_impl: str = "exact",
+    topk_recall: float = 0.95,
 ) -> Proposals:
     """Single-level proposal generation.
 
@@ -46,14 +49,46 @@ def generate_proposals(
       pre_nms_top_n / post_nms_top_n / nms_threshold / min_size: the
         reference's RPN_PRE_NMS_TOP_N / RPN_POST_NMS_TOP_N /
         config.TRAIN.RPN_NMS_THRESH / RPN_MIN_SIZE.
+      topk_impl / topk_recall: pre-NMS selection operator — see
+        ``RPNConfig.topk_impl`` (config.py) for the semantics/parity
+        argument.  Only the strict-subset case (k < A) can go approx;
+        k == A is a plain sort either way.
 
     Returns:
       Fixed-size Proposals; invalid slots carry zeros.
     """
+    boxes, masked_scores = _pre_nms_candidates(
+        scores, deltas, anchors, image_height, image_width,
+        pre_nms_top_n, min_size, topk_impl, topk_recall,
+    )
+    keep_idx, keep_valid = nms_indices(
+        boxes, masked_scores, nms_threshold, post_nms_top_n
+    )
+    rois = jnp.take(boxes, keep_idx, axis=0) * keep_valid[:, None]
+    out_scores = jnp.where(keep_valid, jnp.take(masked_scores, keep_idx), 0.0)
+    return Proposals(rois=rois, scores=out_scores, valid=keep_valid)
+
+
+def _pre_nms_candidates(
+    scores, deltas, anchors, image_height, image_width,
+    pre_nms_top_n: int, min_size: float, topk_impl: str, topk_recall: float,
+):
+    """Shared pre-NMS front half: top-k by objectness, decode, clip, and
+    min-size masking.  Returns (boxes (k, 4), masked_scores (k,)) with
+    suppressed/invalid candidates at ``-inf`` score."""
     a = scores.shape[0]
     k = min(pre_nms_top_n, a)
 
-    top_scores, top_idx = lax.top_k(scores, k)
+    if topk_impl == "approx" and k < a:
+        top_scores, top_idx = lax.approx_max_k(
+            scores, k, recall_target=topk_recall
+        )
+    elif topk_impl in ("exact", "approx"):
+        top_scores, top_idx = lax.top_k(scores, k)
+    else:
+        raise ValueError(
+            f"topk_impl must be 'exact' or 'approx', got {topk_impl!r}"
+        )
     boxes = decode_boxes(
         jnp.take(deltas, top_idx, axis=0), jnp.take(anchors, top_idx, axis=0)
     )
@@ -61,13 +96,7 @@ def generate_proposals(
 
     ok = valid_box_mask(boxes, min_size=min_size)
     masked_scores = jnp.where(ok, top_scores, -jnp.inf)
-
-    keep_idx, keep_valid = nms_indices(
-        boxes, masked_scores, nms_threshold, post_nms_top_n
-    )
-    rois = jnp.take(boxes, keep_idx, axis=0) * keep_valid[:, None]
-    out_scores = jnp.where(keep_valid, jnp.take(masked_scores, keep_idx), 0.0)
-    return Proposals(rois=rois, scores=out_scores, valid=keep_valid)
+    return boxes, masked_scores
 
 
 def generate_fpn_proposals(
@@ -80,32 +109,56 @@ def generate_fpn_proposals(
     post_nms_top_n: int = 1000,
     nms_threshold: float = 0.7,
     min_size: float = 0.0,
+    topk_impl: str = "exact",
+    topk_recall: float = 0.95,
 ) -> Proposals:
     """FPN-style proposals: per-level top-k + NMS, then global top-k by score.
 
     (Detectron recipe: PRE_NMS_TOPK per level, POST_NMS_TOPK across the
     union — the configuration the BASELINE north star's >=37 mAP requires.)
+
+    The per-level NMS runs as ONE vmapped fixed point over the level axis
+    (short levels padded to the widest k with ``-inf`` scores — padding
+    never keeps nor suppresses, so each lane equals its standalone NMS
+    bit-for-bit, tested).  L sequential while-loops would pay L
+    convergence latencies back-to-back; one batched loop pays the
+    worst lane's.  r4 A/B on the train step: see BASELINE.md.
     """
-    per_level = []
     # Detectron recipe: each level may keep up to post_nms_top_n proposals;
     # the global top-k over the union then trims to post_nms_top_n total.
-    for lvl in sorted(level_scores.keys()):
-        p = generate_proposals(
-            level_scores[lvl],
-            level_deltas[lvl],
-            level_anchors[lvl],
-            image_height,
-            image_width,
-            pre_nms_top_n=pre_nms_top_n,
-            post_nms_top_n=post_nms_top_n,
-            nms_threshold=nms_threshold,
-            min_size=min_size,
+    levels = sorted(level_scores.keys())
+    cand = [
+        _pre_nms_candidates(
+            level_scores[lvl], level_deltas[lvl], level_anchors[lvl],
+            image_height, image_width,
+            pre_nms_top_n, min_size, topk_impl, topk_recall,
         )
-        per_level.append(p)
+        for lvl in levels
+    ]
+    kmax = max(b.shape[0] for b, _ in cand)
+    bx = jnp.stack(
+        [jnp.pad(b, ((0, kmax - b.shape[0]), (0, 0))) for b, _ in cand]
+    )                                                       # (L, kmax, 4)
+    sc = jnp.stack(
+        [
+            jnp.pad(s, (0, kmax - s.shape[0]), constant_values=-jnp.inf)
+            for _, s in cand
+        ]
+    )                                                       # (L, kmax)
 
-    rois = jnp.concatenate([p.rois for p in per_level], axis=0)
-    scores = jnp.concatenate([p.scores for p in per_level], axis=0)
-    valid = jnp.concatenate([p.valid for p in per_level], axis=0)
+    keep_idx, keep_valid = jax.vmap(
+        lambda b, s: nms_indices(b, s, nms_threshold, post_nms_top_n)
+    )(bx, sc)                                               # (L, post), (L, post)
+    rois_l = jnp.take_along_axis(
+        bx, keep_idx[..., None], axis=1
+    ) * keep_valid[..., None]
+    scores_l = jnp.where(
+        keep_valid, jnp.take_along_axis(sc, keep_idx, axis=1), 0.0
+    )
+
+    rois = rois_l.reshape(-1, 4)
+    scores = scores_l.reshape(-1)
+    valid = keep_valid.reshape(-1)
 
     masked = jnp.where(valid, scores, -jnp.inf)
     k = min(post_nms_top_n, rois.shape[0])

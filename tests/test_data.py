@@ -63,6 +63,47 @@ class TestSynthetic:
             assert np.all(r.boxes >= 0)
             assert np.all(r.gt_classes >= 1)
 
+    def test_wheel_palette_styles_distinct_and_in_gamut(self):
+        # 80 COCO-scale classes: every class gets a unique (color, stripe)
+        # appearance with no channel saturation (the classic ramp clips
+        # above class ~8 — the soak's documented AP cap).
+        styles = [SyntheticDataset.class_style(c) for c in range(1, 81)]
+        descs = set()
+        for color, period, orient in styles:
+            assert np.all(color >= 0) and np.all(color <= 255)
+            descs.add((tuple(np.round(color, 2)), period, orient))
+        assert len(descs) == 80
+        colors = np.stack([s[0] for s in styles])
+        # Pairwise color separation OR texture difference for every pair.
+        for i in range(80):
+            for j in range(i + 1, 80):
+                same_tex = (
+                    styles[i][1] == styles[j][1]
+                    and styles[i][2] == styles[j][2]
+                )
+                if same_tex:
+                    assert np.abs(colors[i] - colors[j]).max() > 12.0, (i, j)
+
+    def test_wheel_palette_renders(self):
+        ds = SyntheticDataset(
+            num_images=2, image_hw=(64, 64), num_classes=81,
+            dtype="uint8", palette="wheel",
+        )
+        for r in ds.roidb():
+            assert r.image_array.dtype == np.uint8
+
+    def test_classic_palette_bit_stable(self):
+        # The palette option must not perturb the historical pixels the
+        # overfit goldens were recorded on.
+        a = SyntheticDataset(num_images=2, seed=3).roidb()
+        b = SyntheticDataset(num_images=2, seed=3, palette="classic").roidb()
+        for ra, rb in zip(a, b):
+            np.testing.assert_array_equal(ra.image_array, rb.image_array)
+
+    def test_bad_palette_raises(self):
+        with pytest.raises(ValueError, match="palette"):
+            SyntheticDataset(palette="neon")
+
 
 class TestRoidbUtils:
     def test_filter_and_merge(self):

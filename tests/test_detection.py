@@ -297,3 +297,113 @@ class TestUint8Forward:
         np.testing.assert_array_equal(
             np.asarray(dets_a.scores), np.asarray(dets_b.scores)
         )
+
+
+class TestFusedPostprocess:
+    """test.nms_mode="fused" equals the per-class reference path whenever
+    no candidate cap binds (the only semantic difference between them)."""
+
+    def _model_cfg(self, num_classes=11, **test_overrides):
+        m = get_config("tiny_synthetic").model
+        return dataclasses.replace(
+            m,
+            num_classes=num_classes,
+            test=dataclasses.replace(m.test, **test_overrides),
+        )
+
+    def _inputs(self, seed, r=50, c=11, hw=128):
+        rng = np.random.RandomState(seed)
+        x1 = rng.uniform(0, hw - 24, (r, 1))
+        y1 = rng.uniform(0, hw - 24, (r, 1))
+        ww = rng.uniform(8, 48, (r, 1))
+        hh = rng.uniform(8, 48, (r, 1))
+        rois = np.concatenate(
+            [x1, y1, np.minimum(x1 + ww, hw - 1), np.minimum(y1 + hh, hw - 1)],
+            axis=1,
+        ).astype(np.float32)
+        roi_valid = rng.rand(r) < 0.9
+        probs = jax.nn.softmax(jnp.asarray(rng.randn(r, c) * 2, jnp.float32))
+        deltas = jnp.asarray(rng.randn(r, c, 4) * 0.5, jnp.float32)
+        img_hw = jnp.asarray([float(hw), float(hw)], jnp.float32)
+        return (
+            jnp.asarray(rois), jnp.asarray(roi_valid), probs, deltas, img_hw
+        )
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_per_class_when_caps_slack(self, seed):
+        from mx_rcnn_tpu.detection.graph import (
+            _postprocess_one,
+            _postprocess_one_fused,
+        )
+
+        # r=50 <= per_class_k and r*(c-1)=500 <= fused_top_k=1000: no
+        # truncation anywhere, so the two formulations are the same math.
+        m = self._model_cfg()
+        args = self._inputs(seed)
+        b_a, s_a, c_a, v_a = (np.asarray(x) for x in _postprocess_one(m, *args))
+        b_f, s_f, c_f, v_f = (
+            np.asarray(x) for x in _postprocess_one_fused(m, *args)
+        )
+        np.testing.assert_array_equal(v_a, v_f)
+        np.testing.assert_array_equal(c_a, c_f)
+        np.testing.assert_allclose(s_a, s_f, rtol=0, atol=0)
+        np.testing.assert_allclose(b_a, b_f, rtol=1e-6, atol=1e-4)
+
+    def test_high_threshold_few_candidates(self):
+        from mx_rcnn_tpu.detection.graph import (
+            _postprocess_one,
+            _postprocess_one_fused,
+        )
+
+        m = self._model_cfg(score_threshold=0.6)
+        args = self._inputs(3)
+        b_a, s_a, c_a, v_a = (np.asarray(x) for x in _postprocess_one(m, *args))
+        b_f, s_f, c_f, v_f = (
+            np.asarray(x) for x in _postprocess_one_fused(m, *args)
+        )
+        np.testing.assert_array_equal(v_a, v_f)
+        assert v_f.sum() < v_f.shape[0]  # padding slots exercised
+        np.testing.assert_array_equal(c_a, c_f)
+        np.testing.assert_allclose(s_a, s_f, rtol=0, atol=0)
+
+    def test_class_agnostic_deltas(self):
+        from mx_rcnn_tpu.detection.graph import (
+            _postprocess_one,
+            _postprocess_one_fused,
+        )
+
+        m = self._model_cfg()
+        m = dataclasses.replace(
+            m, rcnn=dataclasses.replace(m.rcnn, class_agnostic=True)
+        )
+        rois, rv, probs, deltas, hw = self._inputs(4)
+        deltas = deltas[:, :1, :]  # agnostic head emits one delta set
+        a = _postprocess_one(m, rois, rv, probs, deltas, hw)
+        f = _postprocess_one_fused(m, rois, rv, probs, deltas, hw)
+        np.testing.assert_array_equal(np.asarray(a[3]), np.asarray(f[3]))
+        np.testing.assert_allclose(np.asarray(a[1]), np.asarray(f[1]))
+        np.testing.assert_allclose(np.asarray(a[0]), np.asarray(f[0]), rtol=1e-6, atol=1e-4)
+
+    def test_forward_inference_dispatch(self, fpn_setup, rng):
+        """nms_mode plumbs through forward_inference end-to-end."""
+        cfg, model, variables = fpn_setup
+        batch = tiny_batch(rng, hw=cfg.data.image_size)
+        m_fused = dataclasses.replace(
+            cfg.model, test=dataclasses.replace(cfg.model.test, nms_mode="fused")
+        )
+        model_fused = TwoStageDetector(cfg=m_fused)
+        dets = jax.jit(
+            lambda v, bt: forward_inference(model_fused, v, bt)
+        )(variables, batch)
+        d = cfg.model.test.max_detections
+        assert dets.boxes.shape[1] == d
+        assert bool(jnp.all(jnp.isfinite(dets.boxes)))
+
+    def test_bad_mode_raises(self, fpn_setup, rng):
+        cfg, model, variables = fpn_setup
+        batch = tiny_batch(rng, hw=cfg.data.image_size)
+        bad = dataclasses.replace(
+            cfg.model, test=dataclasses.replace(cfg.model.test, nms_mode="nope")
+        )
+        with pytest.raises(ValueError, match="nms_mode"):
+            forward_inference(TwoStageDetector(cfg=bad), variables, batch)

@@ -239,6 +239,78 @@ def test_generate_proposals_respects_min_size(rng):
     assert np.all(np.asarray(p.rois) == 0)
 
 
+def test_fpn_proposals_batched_nms_equals_per_level(rng):
+    """generate_fpn_proposals' single vmapped NMS fixed point must equal
+    running generate_proposals per level and concatenating (the pre-r4
+    formulation): the level padding must neither keep nor suppress."""
+    from mx_rcnn_tpu.ops.proposals import generate_fpn_proposals
+
+    level_scores, level_deltas, level_anchors = {}, {}, {}
+    for lvl, hw in ((3, (20, 24)), (4, (10, 12)), (5, (5, 6))):
+        base = generate_base_anchors(2**lvl, (0.5, 1.0, 2.0), (8,))
+        anchors = shifted_anchors(jnp.asarray(base), 2**lvl, *hw)
+        a = anchors.shape[0]
+        level_anchors[lvl] = anchors
+        level_scores[lvl] = jnp.asarray(rng.uniform(0, 1, a), jnp.float32)
+        level_deltas[lvl] = jnp.asarray(rng.normal(0, 0.1, (a, 4)), jnp.float32)
+
+    # pre=120 truncates lvl 3 (1440 anchors) but exceeds lvl 5's 90 -> the
+    # level axis mixes truncated and padded lanes, the interesting case.
+    kw = dict(pre_nms_top_n=120, post_nms_top_n=60, nms_threshold=0.7)
+    fused = generate_fpn_proposals(
+        level_scores, level_deltas, level_anchors, 160.0, 192.0, **kw
+    )
+
+    per_level = [
+        generate_proposals(
+            level_scores[lvl], level_deltas[lvl], level_anchors[lvl],
+            160.0, 192.0, **kw,
+        )
+        for lvl in sorted(level_scores)
+    ]
+    rois = jnp.concatenate([p.rois for p in per_level])
+    scores = jnp.concatenate([p.scores for p in per_level])
+    valid = jnp.concatenate([p.valid for p in per_level])
+    masked = jnp.where(valid, scores, -jnp.inf)
+    k = min(kw["post_nms_top_n"], rois.shape[0])
+    top_scores, top_idx = jax.lax.top_k(masked, k)
+    want_valid = np.isfinite(np.asarray(top_scores))
+    want_rois = np.asarray(jnp.take(rois, top_idx, axis=0)) * want_valid[:, None]
+
+    np.testing.assert_array_equal(np.asarray(fused.valid), want_valid)
+    np.testing.assert_array_equal(np.asarray(fused.rois), want_rois)
+    np.testing.assert_array_equal(
+        np.asarray(fused.scores),
+        np.where(want_valid, np.asarray(top_scores), 0.0),
+    )
+    assert int(fused.valid.sum()) > 0
+
+
+def test_generate_proposals_topk_impl(rng):
+    anchors, scores, deltas = _rpn_inputs(rng)
+    exact = generate_proposals(scores, deltas, anchors, 160.0, 192.0,
+                               pre_nms_top_n=200, post_nms_top_n=50)
+    approx = generate_proposals(scores, deltas, anchors, 160.0, 192.0,
+                                pre_nms_top_n=200, post_nms_top_n=50,
+                                topk_impl="approx", topk_recall=0.95)
+    # Basic contract holds under the approx selector...
+    assert approx.rois.shape == (50, 4)
+    assert int(approx.valid.sum()) > 0
+    s = np.asarray(approx.scores)[np.asarray(approx.valid)]
+    assert np.all(np.diff(s) <= 0)
+    # ...and off-TPU approx_max_k lowers to an exact sort, so CPU results
+    # are identical (the parity claim in RPNConfig.topk_impl).
+    if jax.default_backend() == "cpu":
+        np.testing.assert_array_equal(
+            np.asarray(exact.rois), np.asarray(approx.rois)
+        )
+
+    with pytest.raises(ValueError, match="topk_impl"):
+        generate_proposals(scores, deltas, anchors, 160.0, 192.0,
+                           pre_nms_top_n=200, post_nms_top_n=50,
+                           topk_impl="banana")
+
+
 def test_generate_proposals_all_in_one_jit(rng):
     anchors, scores, deltas = _rpn_inputs(rng)
 

@@ -19,10 +19,12 @@ bench or test touches as one continuous run (the reference's analog is
 The dataset is the 81-class synthetic renderer in uint8 form, so the
 trained program is bit-for-bit the flagship r50_fpn_coco train step
 (same class count, same canvas, same dtype path as real COCO training).
-Synthetic class colors saturate above class ~8, so absolute AP is NOT
-comparable to the 5-class overfit golden — the gates here are
-"loss decreased substantially", "every logged metric finite", "lr
-boundaries visible", and "eval AP clears an untrained-model floor".
+Since r4 the renderer uses the "wheel" palette (all 80 classes visually
+distinct); the first r4 soak ran the "classic" ramp, whose color
+saturation above class ~8 capped absolute AP at 0.128 by construction.
+The gates are "loss decreased substantially", "every logged metric
+finite", "lr boundaries visible", and "eval AP clears an
+untrained-model floor".
 
 Usage:  python tools/soak.py [--steps 3000] [--resume-at 1600]
                              [--images 400] [--workdir runs/soak]
@@ -86,6 +88,11 @@ def make_roidb(cfg, num_images: int, seed: int = 1):
         max_objects=8,
         seed=seed,
         dtype="uint8",
+        # All 80 classes visually distinct (golden-ratio hue + texture
+        # combos) — the classic ramp saturates above class ~8 and capped
+        # the r4 soak's absolute AP at 0.128 by renderer design, not by
+        # anything the detector did.
+        palette="wheel",
     ).roidb()
 
 
@@ -274,9 +281,10 @@ def main() -> None:
     # Loss gate against the FIRST logged loss, not the first-5% mean: the
     # steepest descent happens inside the first log window (r4 run: 2.11
     # at step 10, ~1.0 by step 150), so a windowed-mean ratio understates
-    # a perfectly healthy curve.  AP floor: untrained is < 0.001; the
-    # 81-class synthetic renderer saturates class colors above ~8, so
-    # absolute AP stays far below the 5-class overfit golden by design.
+    # a perfectly healthy curve.  AP floor: untrained is < 0.001; 0.02
+    # stays deliberately loose (a soak is a dynamics gate, not a golden —
+    # the wheel palette lifts achievable AP well above it, see
+    # BASELINE.md's soak rows for the measured values).
     ok = (
         summary["nonfinite_count"] == 0
         and summary["mean_last_5pct"] < 0.6 * summary["first_loss"]
