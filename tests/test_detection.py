@@ -384,6 +384,29 @@ class TestFusedPostprocess:
         np.testing.assert_allclose(np.asarray(a[1]), np.asarray(f[1]))
         np.testing.assert_allclose(np.asarray(a[0]), np.asarray(f[0]), rtol=1e-6, atol=1e-4)
 
+    def test_binding_cap_keeps_global_best(self):
+        """When fused_top_k DOES bind, fused equals per-class run on the
+        global top-K candidate subset: the cap drops score-ranked-worst
+        candidates pre-NMS (config.py documents this as the one
+        divergence region vs per_class)."""
+        from mx_rcnn_tpu.detection.graph import _postprocess_one_fused
+
+        m = self._model_cfg(score_threshold=0.0)
+        m = dataclasses.replace(
+            m, test=dataclasses.replace(m.test, fused_top_k=8)
+        )
+        rois, rv, probs, deltas, hw = self._inputs(7, r=20)
+        out = _postprocess_one_fused(m, rois, rv, probs, deltas, hw)
+        kept_scores = np.asarray(out[1])[np.asarray(out[3])]
+        # Every kept detection must come from the global top-8 candidate
+        # scores: nothing below the 8th-ranked candidate can appear.
+        flat = np.asarray(
+            jnp.where(rv[:, None], probs[:, 1:], -jnp.inf)
+        ).ravel()
+        eighth = np.sort(flat)[-8]
+        assert kept_scores.min() >= eighth - 1e-7
+        assert kept_scores.max() == flat.max()  # best candidate survives NMS
+
     def test_forward_inference_dispatch(self, fpn_setup, rng):
         """nms_mode plumbs through forward_inference end-to-end."""
         cfg, model, variables = fpn_setup

@@ -620,17 +620,24 @@ def _infer_breakdown(args, model, params, rest, batch, mcfg) -> None:
     rv = jnp.ones((b, r), bool)
     hw = batch.image_hw
 
-    def post(pr):
-        out = jax.vmap(
-            lambda ro, rv_, p, d, hw_: _postprocess_one(mcfg, ro, rv_, p, d, hw_)
-        )(jnp.asarray(rois), rv, pr, deltas, hw)
-        return pr * 0.0 + (jnp.sum(out[0]) + jnp.sum(out[1]))
+    from mx_rcnn_tpu.detection.graph import _postprocess_one_fused
 
-    dt = timed(jax.jit(post), probs, args.steps)
-    print(
-        f"\nstandalone postprocess ({r} rois x {c - 1} classes) x{b}: "
-        f"{dt * 1e3:8.2f} ms"
-    )
+    for mode, fn in (
+        ("per_class", _postprocess_one),
+        ("fused", _postprocess_one_fused),
+    ):
+        def post(pr, fn=fn):
+            out = jax.vmap(
+                lambda ro, rv_, p, d, hw_: fn(mcfg, ro, rv_, p, d, hw_)
+            )(jnp.asarray(rois), rv, pr, deltas, hw)
+            return pr * 0.0 + (jnp.sum(out[0]) + jnp.sum(out[1]))
+
+        dt = timed(jax.jit(post), probs, args.steps)
+        star = " <- config default" if mcfg.test.nms_mode == mode else ""
+        print(
+            f"\nstandalone postprocess[{mode}] ({r} rois x {c - 1} classes) "
+            f"x{b}: {dt * 1e3:8.2f} ms{star}"
+        )
 
 
 if __name__ == "__main__":

@@ -44,10 +44,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_soak_config(steps: int, workdir: str):
+def build_soak_config(steps: int, workdir: str, preset: str = "r50_fpn_coco"):
     from mx_rcnn_tpu.config import ScheduleConfig, get_config
 
-    cfg = get_config("r50_fpn_coco")
+    cfg = get_config(preset)
     # Absolute step schedule (reference_batch=0: no epoch rescale — the
     # soak pins exact boundaries) with warmup and two decays inside the
     # run.  lr still scales by global_batch/16 = 2/16, i.e. base 0.02 ->
@@ -64,7 +64,7 @@ def build_soak_config(steps: int, workdir: str):
     )
     return dataclasses.replace(
         cfg,
-        name="r50_fpn_soak",
+        name=f"{preset}_soak",
         workdir=workdir,
         data=dataclasses.replace(cfg.data, dataset="synthetic", max_gt_boxes=32),
         train=dataclasses.replace(
@@ -106,6 +106,9 @@ def make_loader(cfg, roidb, batch_size: int):
         train=True,
         seed=cfg.train.seed,
         run_length=max(cfg.train.steps_per_call, 1),
+        # Mask presets need gt masks rasterized (the synthetic roidb
+        # carries octagon polygons) — same wiring train/loop.py uses.
+        with_masks=cfg.model.mask.enabled,
     )
 
 
@@ -189,7 +192,20 @@ def main() -> None:
     ap.add_argument("--images", type=int, default=400)
     ap.add_argument("--workdir", default="runs/soak")
     ap.add_argument("--eval-images", type=int, default=96)
+    ap.add_argument(
+        "--config", default="r50_fpn_coco",
+        help="config preset to soak (e.g. mask_r50_fpn_coco — the mask "
+        "branch then trains and checkpoints through the whole run)",
+    )
     args = ap.parse_args()
+    if args.resume_at and not 0 < args.resume_at < args.steps:
+        # Catch this up front: phase A training past the schedule would
+        # only surface as an assert after the whole run's chip time.
+        ap.error(
+            f"--resume-at {args.resume_at} must lie strictly inside "
+            f"(0, --steps {args.steps}); pass --resume-at 0 to disable "
+            "the resume exercise"
+        )
 
     import jax
 
@@ -205,7 +221,7 @@ def main() -> None:
     from mx_rcnn_tpu.train.loop import train
 
     setup_logging(True)
-    cfg = build_soak_config(args.steps, args.workdir)
+    cfg = build_soak_config(args.steps, args.workdir, preset=args.config)
     # A previous run's checkpoints would hijack phase B's resume (it
     # restores the LATEST step — a stale step-3000 checkpoint makes phase
     # B a no-op and the PASS gate score the old params).  Refuse rather
@@ -281,14 +297,15 @@ def main() -> None:
     # Loss gate against the FIRST logged loss, not the first-5% mean: the
     # steepest descent happens inside the first log window (r4 run: 2.11
     # at step 10, ~1.0 by step 150), so a windowed-mean ratio understates
-    # a perfectly healthy curve.  AP floor: untrained is < 0.001; 0.02
-    # stays deliberately loose (a soak is a dynamics gate, not a golden —
-    # the wheel palette lifts achievable AP well above it, see
-    # BASELINE.md's soak rows for the measured values).
+    # a perfectly healthy curve.  AP floor: see the inline rationale on
+    # the gate below (untrained is < 0.001).
     ok = (
         summary["nonfinite_count"] == 0
         and summary["mean_last_5pct"] < 0.6 * summary["first_loss"]
-        and metrics.get("AP", 0.0) > 0.02
+        # Wheel-palette floor: the r4b run read AP 0.556 (classic-ramp
+        # runs read 0.128 — renderer-capped); 0.25 catches a real
+        # learning regression without pinning a chaotic synthetic value.
+        and metrics.get("AP", 0.0) > 0.25
     )
     print(f"SOAK {'PASS' if ok else 'FAIL'}", file=sys.stderr)
     sys.exit(0 if ok else 1)
