@@ -113,6 +113,22 @@ def _interp_matrix_avg(start, bin_size, num_bins, sr, extent, origin, t):
     return w.reshape(num_bins, sr, t).sum(axis=1) / sr       # (S, T)
 
 
+def _dot_q(a, b, dn, interpret):
+    """dot_general of already-quantized low-precision operands, f32 accum.
+
+    On TPU the operands dot natively (full-rate bf16 MXU passes, f32
+    accumulation).  Under ``interpret`` (the CPU emulation used by tests
+    and the multichip dryrun) the same VALUES dot in f32 instead — the CPU
+    runtime has no BF16xBF16=F32 dot thunk — which is bit-identical: bf16
+    products are exact in f32, and accumulation is f32 either way."""
+    if interpret:
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+    return jax.lax.dot_general(
+        a, b, dimension_numbers=dn, preferred_element_type=jnp.float32
+    )
+
+
 def _kernel(
     roi_ref,       # SMEM block (G, 1, 9+2K) f32, G rois per grid step:
                    # [x1, y1, bin_w, bin_h, H, W, level_idx, batch,
@@ -127,6 +143,7 @@ def _kernel(
     output_size: int,
     sampling_ratio: int,
     group: int,
+    interpret: bool = False,
 ):
     feat_refs = rest[:num_levels]
     out_ref = rest[num_levels]
@@ -204,26 +221,62 @@ def _kernel(
                 wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox_c, tx)
 
                 # rows: (S, Ty) @ (Ty, Tx*C) -> (S, Tx, C).
-                # HIGHEST precision: the interpolation weights are exact
-                # f32; default (bf16 MXU passes) would quantize sample
-                # positions ~2^-8.  A 2-pass split-weight variant was
-                # tried in r3 and REVERTED: with single-tile M the matmuls
-                # are padding-bound, not pass-bound — the split's extra
-                # per-step casts made the forward ~2 ms SLOWER at train
-                # shapes (9.4 -> 11.6 ms).
+                #
+                # Precision, by feature dtype:
+                # - f32 windows (CPU-recipe tests, goldens): HIGHEST with
+                #   exact f32 weights — bit-stable vs the XLA oracle at
+                #   atol 1e-4.
+                # - bf16 windows (the production train/eval graphs): the
+                #   old path upcast the whole window to f32 just so a
+                #   same-dtype HIGHEST dot could run (6 MXU passes).  The
+                #   r4c cost probe showed that cast + those passes were
+                #   the kernel's single largest compute component (first
+                #   dot ~9.8 of 28.8 ms at batch-8 eval), so bf16 windows
+                #   now dot DIRECTLY against hi/lo SPLIT bf16 weights:
+                #   w = w_hi + w_lo reconstructs the f32 weight to ~2^-17
+                #   relative, so the geometric concern that forbids plain
+                #   bf16 weights (a ~2^-8 shift of where features are
+                #   sampled) does not arise — two full-rate bf16 passes
+                #   with f32 accumulation replace six.  The intermediate
+                #   rows then take ONE bf16 quantization (~2^-8, the same
+                #   granularity as the bf16 output itself) before the x
+                #   dot, also split.  Measured (r4c, same-session A/B):
+                #   standalone fwd kernel 8.0 -> 5.9 ms at train shapes,
+                #   27.1 -> 25.6 ms at batch-8 eval.  A THREE-dot exact
+                #   split of the x dot was probed and is SLOWER than the
+                #   f32 path (42 ms eval): per-dot issue overhead, not
+                #   pass count, prices each extra dot (~0.7 us/roi), so
+                #   the one-quantization two-dot form is the optimum.
                 sub = win[g, pl.ds(0, ty), pl.ds(0, tx), :]
-                rows = jax.lax.dot_general(
-                    wy, sub.astype(jnp.float32).reshape(ty, tx * c),
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST,
-                ).reshape(s, tx, c)
-                qpc = jax.lax.dot_general(
-                    wx, rows,
-                    dimension_numbers=(((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST,
-                )                                                 # (Sx, Sy, C)
+                if win.dtype == jnp.bfloat16:
+                    wy_hi = wy.astype(jnp.bfloat16)
+                    wy_lo = (wy - wy_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                    wx_hi = wx.astype(jnp.bfloat16)
+                    wx_lo = (wx - wx_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                    sub_b = sub.reshape(ty, tx * c)
+                    dn = (((1,), (0,)), ((), ()))
+                    rows = (
+                        _dot_q(wy_hi, sub_b, dn, interpret)
+                        + _dot_q(wy_lo, sub_b, dn, interpret)
+                    ).reshape(s, tx, c).astype(jnp.bfloat16)
+                    dn2 = (((1,), (1,)), ((), ()))
+                    qpc = (
+                        _dot_q(wx_hi, rows, dn2, interpret)
+                        + _dot_q(wx_lo, rows, dn2, interpret)
+                    )                                             # (Sx, Sy, C)
+                else:
+                    rows = jax.lax.dot_general(
+                        wy, sub.astype(jnp.float32).reshape(ty, tx * c),
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST,
+                    ).reshape(s, tx, c)
+                    qpc = jax.lax.dot_general(
+                        wx, rows,
+                        dimension_numbers=(((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST,
+                    )                                             # (Sx, Sy, C)
                 out_ref[g] = jnp.swapaxes(qpc, 0, 1).astype(out_ref.dtype)
 
 
@@ -381,6 +434,7 @@ def multilevel_roi_align_pallas(
         output_size=output_size,
         sampling_ratio=sampling_ratio,
         group=grp,
+        interpret=interpret,
     )
     out = pl.pallas_call(
         kernel,
@@ -416,6 +470,7 @@ def _bwd_kernel(
     t: int,
     output_size: int,
     sampling_ratio: int,
+    interpret: bool = False,
 ):
     """Transpose of :func:`_kernel`, accumulated by read-modify-write.
 
@@ -488,11 +543,7 @@ def _bwd_kernel(
     # weight truncation there shifts where features are SAMPLED (a
     # systematic geometric error, not gradient noise) and its measured win
     # was only ~1.5 ms.
-    prec = (
-        jax.lax.Precision.DEFAULT
-        if g.dtype == jnp.bfloat16
-        else jax.lax.Precision.HIGHEST
-    )
+    bf16_cot = g.dtype == jnp.bfloat16
     for ci, (ty, tx) in enumerate(classes):
         oy_c = roi_ref[0, 0, 8 + 2 * ci].astype(jnp.int32)
         ox_c = pl.multiple_of(roi_ref[0, 0, 9 + 2 * ci].astype(jnp.int32), 8)
@@ -503,18 +554,34 @@ def _bwd_kernel(
             wx = _interp_matrix_avg(x1, bin_w, s, sr, wl, ox_c, tx)  # (S, Tx)
             # d_rows_T[tx, sy, c] = sum_sx wx[sx, tx] * d_qpc[sx, sy, c] —
             # the SMALL matmul (N = S*C), against the native cotangent.
-            d_rows_t = jax.lax.dot_general(
-                wx, d_qpc.astype(jnp.float32).reshape(s, s * c),
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=prec,
-            ).reshape(tx, s, c)                                # (Tx, Sy, C)
-            d_window = jax.lax.dot_general(
-                wy, d_rows_t,
-                dimension_numbers=(((0,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=prec,
-            )                                                  # (Ty, Tx, C)
+            # bf16 cotangents dot DIRECTLY as bf16 operands with
+            # single-bf16 weights (no f32 upcast of the cotangent): the
+            # ~2^-8 weight truncation is plain gradient noise here, below
+            # the cotangent's own quantization (the precision note above);
+            # the geometric-exactness argument that makes the FORWARD use
+            # hi/lo split weights does not apply to a backward.
+            dn1 = (((0,), (0,)), ((), ()))
+            dn2 = (((0,), (1,)), ((), ()))
+            if bf16_cot:
+                d_rows_t = _dot_q(
+                    wx.astype(g.dtype), d_qpc.reshape(s, s * c), dn1, interpret
+                ).reshape(tx, s, c)                            # (Tx, Sy, C)
+                d_window = _dot_q(
+                    wy.astype(g.dtype), d_rows_t.astype(g.dtype), dn2, interpret
+                )                                              # (Ty, Tx, C)
+            else:
+                d_rows_t = jax.lax.dot_general(
+                    wx, d_qpc.astype(jnp.float32).reshape(s, s * c),
+                    dimension_numbers=dn1,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                ).reshape(tx, s, c)                            # (Tx, Sy, C)
+                d_window = jax.lax.dot_general(
+                    wy, d_rows_t,
+                    dimension_numbers=dn2,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )                                              # (Ty, Tx, C)
 
             for i, gl in enumerate(out_refs):
                 th = min(ty, gl.shape[1])
@@ -579,6 +646,7 @@ def multilevel_roi_align_bwd_pallas(
         t=t,
         output_size=s,
         sampling_ratio=sampling_ratio,
+        interpret=interpret,
     )
     grads = pl.pallas_call(
         kernel,

@@ -336,10 +336,17 @@ class TestPallasRoiAlign:
         for l in pyr:
             assert g_pal[l].dtype == jnp.bfloat16
             assert g_pal[l].shape == pyr[l].shape
+            # Tolerance: the reference vjp carries exact-f32 interpolation
+            # weights; the kernel's bf16-cotangent path quantizes the
+            # weights to bf16 (documented in _bwd_kernel — gradient noise
+            # ~2^-8 relative, below the cotangent's own granularity), so
+            # per-cell diffs up to a few bf16 ULPs of the accumulated
+            # magnitude (~0.1 at the ~6-8 peaks here) are expected.
             np.testing.assert_allclose(
                 np.asarray(g_pal[l], np.float32),
                 np.asarray(g_ref[l], np.float32),
-                atol=6e-2,
+                atol=3e-2,
+                rtol=2.5e-2,
             )
 
 
