@@ -119,8 +119,11 @@ def _dot_q(a, b, dn, interpret):
     On TPU the operands dot natively (full-rate bf16 MXU passes, f32
     accumulation).  Under ``interpret`` (the CPU emulation used by tests
     and the multichip dryrun) the same VALUES dot in f32 instead — the CPU
-    runtime has no BF16xBF16=F32 dot thunk — which is bit-identical: bf16
-    products are exact in f32, and accumulation is f32 either way."""
+    runtime has no BF16xBF16=F32 dot thunk.  The two are value-equivalent
+    up to f32 summation order: each bf16 product is exact in f32, but the
+    backends may reduce in different orders, so interpret-mode tests are
+    an up-to-rounding oracle for the TPU path, NOT a bitwise one (the
+    on-TPU parity check lives in tests/test_overfit_tpu.py)."""
     if interpret:
         a = a.astype(jnp.float32)
         b = b.astype(jnp.float32)
@@ -537,7 +540,9 @@ def _bwd_kernel(
     # kernel replaced (hundreds of bf16 += per P2 cell).  On-chip check
     # (the off-TPU interpret tests can't see MXU truncation): max
     # |pallas - xla-autodiff| feature-grad diff at R101 train shapes is
-    # within bf16 output granularity.  Measured 10.7 -> 6.1 ms at R101
+    # within bf16 output granularity — gated by the opt-in
+    # RUN_POOL_BWD_TPU=1 test (tests/test_pool_bwd_tpu.py; r5 recorded
+    # worst_rel 0.0092 ~ 2.4 ulps).  Measured 10.7 -> 6.1 ms at R101
     # train shapes vs HIGHEST.  f32 cotangents (CPU-recipe tests, golden
     # paths) keep the exact HIGHEST dot.  The FORWARD stays HIGHEST always:
     # weight truncation there shifts where features are SAMPLED (a

@@ -24,6 +24,48 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
+
+# Comma-joined run of LLVM ±feature tokens, e.g.
+# "+64bit,+avx2,...,+prefer-no-scatter,+prefer-no-gather,-amx-fp16,..."
+_FEATURE_RUN = re.compile(rb"[+-][a-z0-9_.\-]+(?:,[+-][a-z0-9_.\-]+){8,}")
+
+
+def llvm_target_features() -> str | None:
+    """The LLVM target-feature string XLA:CPU actually compiles with.
+
+    Extracted from a tiny AOT probe: serialize a trivial compiled
+    executable and pull the longest ``+feat,-feat,...`` run out of its
+    bytes.  This is the string whose cross-host mismatch produced the r3
+    golden drift and the r4 ``cpu_aot_loader.cc`` errors
+    (``+prefer-no-scatter,+prefer-no-gather`` present on one host, absent
+    on the other) — r4's /proc/cpuinfo proxy demonstrably still collided
+    (MULTICHIP_r04 tail), so r5 keys on the decision itself instead of
+    its inputs.  Verified present in the serialized blob on this image
+    (jaxlib 0.8.x: 3.4 KB probe, feature run embedded verbatim).
+
+    Requires an initialized XLA:CPU backend — both callers pin
+    ``jax_platforms`` to cpu before calling.  Returns None if anything in
+    the probe path is unavailable (caller falls back to cpuinfo).
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() != "cpu":
+            return None
+        probe = (
+            jax.jit(lambda x: x @ x)
+            .lower(jnp.zeros((4, 4), jnp.float32))
+            .compile()
+        )
+        blob = probe.runtime_executable().serialize()
+        runs = [m.group(0) for m in _FEATURE_RUN.finditer(blob)]
+        if not runs:
+            return None
+        return max(runs, key=len).decode()
+    except Exception:
+        return None
 
 
 def cpu_fingerprint() -> str:
@@ -56,9 +98,16 @@ def cpu_fingerprint() -> str:
     narrower, remains possible on truly identical fleet hardware — which
     is also the one case where sharing blobs is safe.
 
-    Note: strengthening this key (r4) intentionally orphans caches warmed
-    under the flags-only r3 key; first runs after the change pay a full
-    recompile.
+    r5: the PRIMARY key is now ``llvm_target_features()`` — the exact
+    string whose mismatch is the failure mode — because the r4
+    cpuinfo-proxy key demonstrably still collided on the driver host
+    (MULTICHIP_r04's ``cpu_aot_loader.cc`` tail).  The cpuinfo/uname
+    material stays mixed in as a tiebreak for the (observed-empty) case
+    where the probe is unavailable.
+
+    Note: strengthening this key (r4, again r5) intentionally orphans
+    caches warmed under the previous key; first runs after the change pay
+    a full recompile.
     """
     import jaxlib
 
@@ -86,6 +135,8 @@ def cpu_fingerprint() -> str:
         import platform
 
         key = repr(platform.uname())
+    feats = llvm_target_features()
+    key += "\nllvm_target_features=" + (feats if feats is not None else "?")
     key += "\njaxlib=" + jaxlib.version.__version__
     return hashlib.sha1(key.encode()).hexdigest()[:8]
 

@@ -1,0 +1,103 @@
+"""On-TPU ROIAlign backward parity worker (ADVICE r4).
+
+Runs on the REAL chip (no platform surgery): computes the feature-pyramid
+gradient through ``multilevel_roi_align_fast`` at R101-FPN train shapes
+with a bf16 cotangent twice — once with the production Pallas window-RMW
+backward, once with ``MX_RCNN_POOL_BWD=xla`` (autodiff of the XLA
+reference) — and prints their element-wise difference stats as one
+``RESULT {json}`` line.
+
+The interpret-mode CPU tests cannot see MXU bf16 truncation, so this is
+the only oracle for the on-chip claim in ``_bwd_kernel``'s precision
+note ("within bf16 output granularity vs XLA autodiff at R101 shapes").
+
+The two backends are selected by distinct traced functions (the env var
+is read at TRACE time inside ``_fast_bwd``; reusing one jitted function
+would silently replay the first trace's choice).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+    )
+
+    from mx_rcnn_tpu.ops.pallas.roi_align import multilevel_roi_align_fast
+
+    # R101-FPN train shapes: batch 2, 800x1344 canvas, P2-P5 at 256ch,
+    # 512 sampled rois per image, bf16 compute dtype.
+    B, R, C = 2, 512, 256
+    canvas_h, canvas_w = 800, 1344
+    rng = np.random.default_rng(0)
+    pyramid = {
+        lvl: jnp.asarray(
+            rng.standard_normal((B, canvas_h // s, canvas_w // s, C)),
+            jnp.bfloat16,
+        )
+        for lvl, s in ((2, 4), (3, 8), (4, 16), (5, 32))
+    }
+    # Boxes log-uniform in size 16..600 px so all four levels get rois.
+    sizes = np.exp(rng.uniform(np.log(16), np.log(600), (B, R, 2)))
+    cx = rng.uniform(0, canvas_w, (B, R))
+    cy = rng.uniform(0, canvas_h, (B, R))
+    x1 = np.clip(cx - sizes[..., 0] / 2, 0, canvas_w - 2)
+    y1 = np.clip(cy - sizes[..., 1] / 2, 0, canvas_h - 2)
+    x2 = np.clip(x1 + sizes[..., 0], x1 + 1, canvas_w - 1)
+    y2 = np.clip(y1 + sizes[..., 1], y1 + 1, canvas_h - 1)
+    rois = jnp.asarray(np.stack([x1, y1, x2, y2], -1), jnp.float32)
+
+    # Fixed bf16 cotangent via a linear loss: grad arrives in the output
+    # dtype (bf16), exactly as in the train graph.
+    cot = jnp.asarray(
+        rng.standard_normal((B, R, 7, 7, C)), jnp.bfloat16
+    )
+
+    def make_loss():
+        def loss(p):
+            out = multilevel_roi_align_fast(p, rois)
+            return jnp.sum(out.astype(jnp.float32) * cot.astype(jnp.float32))
+
+        return loss
+
+    os.environ["MX_RCNN_POOL_BWD"] = "pallas"
+    g_pallas = jax.jit(jax.grad(make_loss()))(pyramid)
+    jax.block_until_ready(g_pallas)
+    os.environ["MX_RCNN_POOL_BWD"] = "xla"
+    g_xla = jax.jit(jax.grad(make_loss()))(pyramid)
+
+    stats = {}
+    worst = 0.0
+    for lvl in pyramid:
+        a = np.asarray(jax.device_get(g_pallas[lvl]), np.float32)
+        b = np.asarray(jax.device_get(g_xla[lvl]), np.float32)
+        scale = float(np.abs(b).max()) or 1.0
+        diff = float(np.abs(a - b).max())
+        stats[f"P{lvl}"] = {
+            "max_abs_diff": diff,
+            "grad_scale": scale,
+            "rel": diff / scale,
+        }
+        worst = max(worst, diff / scale)
+    out = {
+        "platform": jax.devices()[0].platform,
+        "worst_rel": worst,
+        "levels": stats,
+    }
+    print("RESULT " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Unit tests of the persistent-compile-cache key (VERDICT r4 #5).
+
+The cache key's job is: two hosts whose XLA:CPU codegen differs must get
+different directories.  r3/r4 proved the /proc/cpuinfo proxy can collide
+(identical kernel-reported flags, different LLVM preference features —
+the ``cpu_aot_loader.cc`` mismatch tail in MULTICHIP_r04), so the r5 key
+is the LLVM target-feature string itself, extracted from a serialized
+probe executable.  These tests pin the key's inputs and sensitivity.
+"""
+
+from __future__ import annotations
+
+from mx_rcnn_tpu.utils import compile_cache
+
+
+class TestLlvmTargetFeatures:
+    def test_probe_extracts_a_feature_run_on_cpu_backend(self):
+        # The suite runs with jax pinned to the fake-CPU backend
+        # (conftest), which is exactly the production condition of both
+        # callers — the probe must work here, not fall back.
+        feats = compile_cache.llvm_target_features()
+        assert feats is not None, (
+            "probe fell back on the CPU backend — the r5 key would "
+            "silently degrade to the collision-prone cpuinfo proxy"
+        )
+        toks = feats.split(",")
+        assert len(toks) > 8
+        assert all(t[0] in "+-" for t in toks)
+
+    def test_probe_is_deterministic(self):
+        assert (
+            compile_cache.llvm_target_features()
+            == compile_cache.llvm_target_features()
+        )
+
+    def test_fingerprint_keys_on_feature_string(self, monkeypatch):
+        base = compile_cache.cpu_fingerprint()
+        # The exact r3/r4 failure mode: same cpuinfo, one preference flag
+        # different.  The fingerprint MUST move.
+        real = compile_cache.llvm_target_features()
+        assert real is not None, "probe unavailable — see first test"
+        flipped = real.replace(
+            "+prefer-no-scatter", "-prefer-no-scatter"
+        ) if "+prefer-no-scatter" in real else real + ",+prefer-no-scatter"
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features", lambda: flipped
+        )
+        assert compile_cache.cpu_fingerprint() != base
+
+    def test_fingerprint_survives_probe_failure(self, monkeypatch):
+        # No-probe hosts degrade to the cpuinfo/uname key, distinctly
+        # from any real feature string ("?" sentinel).
+        base = compile_cache.cpu_fingerprint()
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features", lambda: None
+        )
+        fp = compile_cache.cpu_fingerprint()
+        assert len(fp) == 8
+        assert fp != base
+
+    def test_fingerprint_stable_across_calls(self):
+        assert compile_cache.cpu_fingerprint() == compile_cache.cpu_fingerprint()

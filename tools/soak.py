@@ -306,6 +306,13 @@ def main() -> None:
         # runs read 0.128 — renderer-capped); 0.25 catches a real
         # learning regression without pinning a chaotic synthetic value.
         and metrics.get("AP", 0.0) > 0.25
+        # Mask presets must also gate the mask head: a segm regression to
+        # zero with a healthy box head would otherwise still PASS.  Floor
+        # is below the r4b run's 0.2573 by the same margin logic as box.
+        and (
+            not cfg.model.mask.enabled
+            or metrics.get("segm/AP", 0.0) > 0.12
+        )
     )
     print(f"SOAK {'PASS' if ok else 'FAIL'}", file=sys.stderr)
     sys.exit(0 if ok else 1)
