@@ -60,3 +60,11 @@ def default_use_07_metric(cfg: Config) -> bool:
     AP for VOC2007 test splits (the reference evaluates VOC07 with
     use_07_metric=True), the area metric everywhere else."""
     return cfg.data.dataset == "voc" and cfg.data.val_split.startswith("2007")
+
+
+def submission_imageset(cfg: Config) -> str:
+    """The imageset token for comp4 det filenames: VOC splits are
+    "<year>_<imageset>" so the filename takes the imageset part
+    ("comp4_det_test_<cls>.txt"); other datasets use the split verbatim."""
+    split = cfg.data.val_split
+    return split.split("_")[-1] if cfg.data.dataset == "voc" else split

@@ -14,7 +14,12 @@ import logging
 import pickle
 from typing import Optional
 
-from mx_rcnn_tpu.cli.common import add_config_args, config_from_args, setup_logging
+from mx_rcnn_tpu.cli.common import (
+    add_config_args,
+    config_from_args,
+    setup_logging,
+    submission_imageset,
+)
 from mx_rcnn_tpu.config import Config
 
 log = logging.getLogger("mx_rcnn_tpu.eval")
@@ -27,6 +32,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--step", type=int, default=None, help="checkpoint step")
     p.add_argument(
         "--dump", default=None, help="write raw detections here (reeval input)"
+    )
+    p.add_argument(
+        "--dump-coco", default=None, metavar="RESULTS.JSON",
+        help="also write a COCO results json in ORIGINAL (sparse 91-space) "
+        "category ids — the format the COCO server and stock pycocotools "
+        "loadRes score (reference coco.py evaluate_detections parity)",
+    )
+    p.add_argument(
+        "--dump-voc", default=None, metavar="DIR",
+        help="also write VOC comp4 per-class det files into DIR "
+        "(reference pascal_voc.py det-file-writer parity)",
     )
     p.add_argument(
         "--proposals",
@@ -74,7 +90,8 @@ def _eval_loader(
     import jax
 
     proposals = load_proposals(proposals_path) if proposals_path else None
-    roidb = build_dataset(cfg.data, train=False).roidb()
+    dataset = build_dataset(cfg.data, train=False)
+    roidb = dataset.roidb()
     loader = DetectionLoader(
         roidb, cfg.data, batch_size=batch_size, train=False,
         with_masks=with_masks,
@@ -85,7 +102,7 @@ def _eval_loader(
         rank=jax.process_index(),
         world=jax.process_count(),
     )
-    return roidb, loader
+    return dataset, roidb, loader
 
 
 def _restored_state(cfg: Config, ckpt_dir: Optional[str], step: Optional[int]):
@@ -116,6 +133,8 @@ def run_eval(
     use_07_metric: Optional[bool] = None,
     vis_count: int = 0,
     proposals_path: Optional[str] = None,
+    coco_results_path: Optional[str] = None,
+    voc_dets_dir: Optional[str] = None,
 ) -> dict:
     """Evaluate a state (or a restored checkpoint) on the config's val split.
 
@@ -165,7 +184,7 @@ def run_eval(
         else jax.device_put(variables)
     )
     per_chip = max(cfg.model.test.per_device_batch, 1)
-    roidb, loader = _eval_loader(
+    dataset, roidb, loader = _eval_loader(
         cfg,
         batch_size=(mesh.size if mesh is not None else 1) * per_chip,
         proposals_path=proposals_path,
@@ -176,6 +195,15 @@ def run_eval(
         from mx_rcnn_tpu.data.datasets import VOC_CLASSES
 
         class_names = ("__background__",) + VOC_CLASSES
+    elif voc_dets_dir:
+        # comp4 files are per-class-NAME; non-VOC datasets use their own.
+        class_names = tuple(getattr(dataset, "classes", ()))
+    # COCO submissions must carry the ORIGINAL sparse category ids; only
+    # the real CocoDataset has the mapping (synthetic/custom ids are
+    # already dense → identity).
+    label_to_cat = (
+        getattr(dataset, "label_to_cat", None) if coco_results_path else None
+    )
     metrics = pred_eval(
         eval_step,
         variables,
@@ -189,6 +217,10 @@ def run_eval(
         vis_dir=f"{cfg.workdir}/{cfg.name}/vis" if vis_count > 0 else None,
         vis_count=vis_count,
         mesh=mesh,
+        coco_results_path=coco_results_path,
+        label_to_cat=label_to_cat,
+        voc_dets_dir=voc_dets_dir,
+        voc_imageset=submission_imageset(cfg),
     )
     for k, v in sorted(metrics.items()):
         log.info("%s = %.4f", k, v)
@@ -317,6 +349,8 @@ def main(argv=None) -> dict:
         use_07_metric=args.use_07_metric,
         vis_count=args.vis,
         proposals_path=args.from_proposals,
+        coco_results_path=args.dump_coco,
+        voc_dets_dir=args.dump_voc,
     )
 
 

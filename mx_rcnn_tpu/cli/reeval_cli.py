@@ -25,6 +25,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         default=None,
         help="VOC 11-point AP (default: auto — on for VOC2007 test splits)",
     )
+    p.add_argument(
+        "--dump-coco", default=None, metavar="RESULTS.JSON",
+        help="export the cached detections as a COCO results json in "
+        "ORIGINAL sparse category ids (submission format) — no model run",
+    )
+    p.add_argument(
+        "--dump-voc", default=None, metavar="DIR",
+        help="export the cached detections as VOC comp4 det files",
+    )
     return p.parse_args(argv)
 
 
@@ -37,7 +46,20 @@ def main(argv=None) -> dict:
     from mx_rcnn_tpu.evalutil import evaluate_detections, load_detections
 
     per_image = load_detections(args.detections)
-    roidb = build_dataset(cfg.data, train=False).roidb()
+    dataset = build_dataset(cfg.data, train=False)
+    roidb = dataset.roidb()
+    if args.dump_coco or args.dump_voc:
+        from mx_rcnn_tpu.cli.common import submission_imageset
+        from mx_rcnn_tpu.evalutil.submission import write_submission_artifacts
+
+        write_submission_artifacts(
+            per_image,
+            coco_results_path=args.dump_coco,
+            label_to_cat=getattr(dataset, "label_to_cat", None),
+            voc_dets_dir=args.dump_voc,
+            class_names=tuple(getattr(dataset, "classes", ())),
+            voc_imageset=submission_imageset(cfg),
+        )
     from mx_rcnn_tpu.cli.common import default_use_07_metric
 
     use_07 = args.use_07_metric

@@ -15,6 +15,12 @@ from mx_rcnn_tpu.evalutil.pred_eval import (
     evaluate_detections,
     pred_eval,
 )
+from mx_rcnn_tpu.evalutil.submission import (
+    read_coco_results,
+    read_voc_dets,
+    write_coco_results,
+    write_voc_dets,
+)
 from mx_rcnn_tpu.evalutil.voc_eval import voc_ap, voc_eval
 
 __all__ = [
@@ -23,7 +29,11 @@ __all__ = [
     "evaluate_detections",
     "load_detections",
     "pred_eval",
+    "read_coco_results",
+    "read_voc_dets",
     "save_detections",
     "voc_ap",
     "voc_eval",
+    "write_coco_results",
+    "write_voc_dets",
 ]

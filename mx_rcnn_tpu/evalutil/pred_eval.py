@@ -195,12 +195,32 @@ def pred_eval(
     vis_dir: Optional[str] = None,
     vis_count: int = 10,
     mesh=None,
+    coco_results_path: Optional[str] = None,
+    label_to_cat=None,
+    voc_dets_dir: Optional[str] = None,
+    voc_imageset: str = "test",
 ) -> dict[str, float]:
+    """``coco_results_path`` / ``voc_dets_dir`` additionally write the
+    official interchange artifacts (COCO results json in ORIGINAL sparse
+    category ids via ``label_to_cat``; VOC comp4 det files) — the
+    reference's ``evaluate_detections`` side-effect outputs that external
+    tools and the eval servers consume (SURVEY.md §3.6)."""
     per_image = collect_detections(eval_step, variables, loader, mesh=mesh)
     # Multi-host: every host holds the full (gathered) detections and
     # computes identical metrics; artifacts are written once, by process 0.
     if dump_path and jax.process_index() == 0:
         save_detections(dump_path, per_image)
+    if (coco_results_path or voc_dets_dir) and jax.process_index() == 0:
+        from mx_rcnn_tpu.evalutil.submission import write_submission_artifacts
+
+        write_submission_artifacts(
+            per_image,
+            coco_results_path=coco_results_path,
+            label_to_cat=label_to_cat,
+            voc_dets_dir=voc_dets_dir,
+            class_names=class_names or (),
+            voc_imageset=voc_imageset,
+        )
     if vis_dir and jax.process_index() == 0:
         n = visualize_detections(
             per_image, roidb, vis_dir, class_names, count=vis_count

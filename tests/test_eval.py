@@ -303,3 +303,200 @@ class TestGreedyMatchVectorized:
         assert fast.keys() == slow.keys()
         for k in fast:
             assert fast[k] == pytest.approx(slow[k], abs=1e-12), k
+
+
+class TestSubmissionFormats:
+    """COCO results-json + VOC comp4 interchange (VERDICT r4 #3).
+
+    The writers are the reference's external-tool outputs
+    (``rcnn/dataset/coco.py :: evaluate_detections`` results json,
+    ``rcnn/dataset/pascal_voc.py`` det files — SURVEY.md §3.6); these
+    tests pin the wire format and assert write→read is metric-identical
+    through the internal evaluator."""
+
+    def _per_image(self, with_masks=False):
+        from mx_rcnn_tpu.evalutil.masks import rle_encode
+
+        rng = np.random.RandomState(3)
+        out = {}
+        for img in ("11", "42"):
+            n = 4
+            x1 = rng.uniform(0, 60, n); y1 = rng.uniform(0, 60, n)
+            w = rng.uniform(5, 30, n); h = rng.uniform(5, 30, n)
+            entry = {
+                "boxes": np.stack([x1, y1, x1 + w, y1 + h], 1).astype(np.float32),
+                "scores": rng.rand(n).astype(np.float32),
+                "classes": rng.randint(1, 4, n).astype(np.int32),
+            }
+            if with_masks:
+                entry["masks"] = [
+                    rle_encode(rng.rand(100, 100) > 0.6) for _ in range(n)
+                ]
+            out[img] = entry
+        return out
+
+    def test_coco_wire_format(self, tmp_path):
+        from mx_rcnn_tpu.evalutil import write_coco_results
+
+        # Sparse 91-space ids, as CocoDataset.label_to_cat produces.
+        label_to_cat = {1: 1, 2: 3, 3: 90}
+        per_image = self._per_image()
+        path = str(tmp_path / "results.json")
+        n = write_coco_results(path, per_image, label_to_cat)
+        assert n == 8
+        import json
+
+        with open(path) as f:
+            results = json.load(f)
+        assert isinstance(results, list) and len(results) == 8
+        for r in results:
+            assert set(r) == {"image_id", "category_id", "bbox", "score"}
+            assert isinstance(r["image_id"], int)  # numeric ids → ints
+            assert r["category_id"] in (1, 3, 90)  # ORIGINAL sparse space
+            x, y, w, h = r["bbox"]
+            assert w > 0 and h > 0
+        # xywh inverse of the reader's x2 = x + w - 1 convention.
+        first = per_image["11"]
+        r0 = [r for r in results if r["image_id"] == 11][0]
+        j = 0
+        assert r0["bbox"][2] == pytest.approx(
+            float(first["boxes"][j, 2] - first["boxes"][j, 0] + 1), abs=0.01
+        )
+
+    def test_coco_roundtrip_metric_identical(self, tmp_path):
+        from mx_rcnn_tpu.evalutil import read_coco_results, write_coco_results
+
+        label_to_cat = {1: 1, 2: 3, 3: 90}
+        cat_to_label = {v: k for k, v in label_to_cat.items()}
+        per_image = self._per_image(with_masks=True)
+        path = str(tmp_path / "results.json")
+        write_coco_results(path, per_image, label_to_cat)
+        back = read_coco_results(path, cat_to_label)
+
+        rng = np.random.RandomState(9)
+        roidb = [
+            RoiRecord(
+                img, "", 100, 100,
+                d["boxes"] + rng.uniform(-3, 3, d["boxes"].shape).astype(np.float32),
+                d["classes"],
+            )
+            for img, d in per_image.items()
+        ]
+        a = evaluate_detections(per_image, roidb, num_classes=4, style="coco")
+        b = evaluate_detections(back, roidb, num_classes=4, style="coco")
+        assert a.keys() == b.keys()
+        for k in a:
+            # bbox coords rounded to 2dp / scores to 5dp on the wire: the
+            # metric must not move beyond that quantization.
+            assert a[k] == pytest.approx(b[k], abs=1e-3), k
+
+    def test_voc_comp4_format_and_roundtrip(self, tmp_path):
+        from mx_rcnn_tpu.evalutil import read_voc_dets, write_voc_dets
+
+        names = ("__background__", "cat", "dog", "bird")
+        per_image = self._per_image()
+        paths = write_voc_dets(str(tmp_path), per_image, names, imageset="test")
+        assert [p.split("/")[-1] for p in paths] == [
+            "comp4_det_test_cat.txt",
+            "comp4_det_test_dog.txt",
+            "comp4_det_test_bird.txt",
+        ]
+        # Devkit line format: "id score x1 y1 x2 y2", 1-BASED coords.
+        with open(paths[0]) as f:
+            lines = [l.split() for l in f if l.strip()]
+        for parts in lines:
+            assert len(parts) == 6
+            assert parts[0] in ("11", "42")
+            assert 0.0 <= float(parts[1]) <= 1.0
+        cat_dets = [
+            (img, j)
+            for img, d in per_image.items()
+            for j in np.flatnonzero(d["classes"] == 1)
+        ]
+        assert len(lines) == len(cat_dets)
+        img0, j0 = cat_dets[0]
+        assert float(lines[0][2]) == pytest.approx(
+            float(per_image[img0]["boxes"][j0, 0]) + 1, abs=0.06
+        )
+
+        back = read_voc_dets(str(tmp_path), names, imageset="test")
+        roidb = [
+            RoiRecord(img, "", 100, 100, d["boxes"], d["classes"])
+            for img, d in per_image.items()
+        ]
+        a = evaluate_detections(
+            per_image, roidb, num_classes=4, style="voc", class_names=names
+        )
+        b = evaluate_detections(
+            back, roidb, num_classes=4, style="voc", class_names=names
+        )
+        for k in a:
+            # 1dp coordinate quantization on the wire.
+            assert a[k] == pytest.approx(b[k], abs=2e-2), k
+
+    def test_empty_class_still_writes_file(self, tmp_path):
+        from mx_rcnn_tpu.evalutil import write_voc_dets
+
+        per_image = {
+            "1": {
+                "boxes": np.array([[0, 0, 5, 5]], np.float32),
+                "scores": np.array([0.9], np.float32),
+                "classes": np.array([1], np.int32),
+            }
+        }
+        paths = write_voc_dets(
+            str(tmp_path), per_image, ("bg", "cat", "dog"), imageset="val"
+        )
+        import os
+
+        assert all(os.path.exists(p) for p in paths)
+        assert os.path.getsize(paths[1]) == 0  # dog: present but empty
+
+    def test_stock_pycocotools_cross_check(self, tmp_path):
+        """Score our results json with STOCK pycocotools against our own
+        evaluator (the r4 gap: no path existed to cross-check).  Skips
+        where pycocotools isn't installed (this image); runs anywhere
+        real-data work happens."""
+        pytest.importorskip("pycocotools")
+        import json
+
+        from pycocotools.coco import COCO
+        from pycocotools.cocoeval import COCOeval
+
+        from mx_rcnn_tpu.evalutil import write_coco_results
+
+        label_to_cat = {1: 1, 2: 3, 3: 90}
+        per_image = self._per_image()
+        rng = np.random.RandomState(9)
+        images, anns = [], []
+        roidb = []
+        for img, d in per_image.items():
+            images.append({"id": int(img), "width": 100, "height": 100})
+            gt = d["boxes"] + rng.uniform(-3, 3, d["boxes"].shape).astype(np.float32)
+            roidb.append(RoiRecord(img, "", 100, 100, gt, d["classes"]))
+            for b, c in zip(gt, d["classes"]):
+                anns.append({
+                    "id": len(anns) + 1, "image_id": int(img),
+                    "category_id": label_to_cat[int(c)],
+                    "bbox": [float(b[0]), float(b[1]),
+                             float(b[2] - b[0] + 1), float(b[3] - b[1] + 1)],
+                    "area": float((b[2] - b[0] + 1) * (b[3] - b[1] + 1)),
+                    "iscrowd": 0,
+                })
+        gt_path = str(tmp_path / "gt.json")
+        with open(gt_path, "w") as f:
+            json.dump({
+                "images": images, "annotations": anns,
+                "categories": [
+                    {"id": v, "name": str(k)} for k, v in label_to_cat.items()
+                ],
+            }, f)
+        res_path = str(tmp_path / "results.json")
+        write_coco_results(res_path, per_image, label_to_cat)
+
+        coco = COCO(gt_path)
+        ev = COCOeval(coco, coco.loadRes(res_path), "bbox")
+        ev.evaluate(); ev.accumulate(); ev.summarize()
+        ours = evaluate_detections(per_image, roidb, num_classes=4, style="coco")
+        assert ours["AP"] == pytest.approx(ev.stats[0], abs=1e-3)
+        assert ours["AP50"] == pytest.approx(ev.stats[1], abs=1e-3)
