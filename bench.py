@@ -126,6 +126,23 @@ def _cost_analysis(step_fn, state, data, k, dt_per_call):
         file=sys.stderr,
     )
     try:
+        from mx_rcnn_tpu.utils.hlo_profile import attribute_flops
+
+        comps = attribute_flops(step_fn, state, data)
+        total = sum(c["flops"] for c in comps.values()) or 1.0
+        ranked = sorted(
+            comps.items(), key=lambda kv: kv[1]["flops"], reverse=True
+        )
+        print(
+            "per-component: " + ", ".join(
+                f"{name} {c['flops']/k/1e9:.0f}GF ({c['flops']/total*100:.0f}%)"
+                for name, c in ranked if c["flops"] / total >= 0.01
+            ) + "  [full table: tools/mfu_report.py]",
+            file=sys.stderr,
+        )
+    except Exception as e:  # pragma: no cover
+        print(f"per-component attribution failed: {e!r}", file=sys.stderr)
+    try:
         ca = step_fn.lower(state, data).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]

@@ -198,6 +198,16 @@ def run_eval(
     elif voc_dets_dir:
         # comp4 files are per-class-NAME; non-VOC datasets use their own.
         class_names = tuple(getattr(dataset, "classes", ()))
+    if voc_dets_dir and len(class_names or ()) <= 1:
+        # write_submission_artifacts raises the same complaint, but only
+        # AFTER pred_eval's full inference pass (and only on the artifact-
+        # writing process) — minutes of eval discarded by an error that is
+        # knowable right here.  Fail up-front, on every host.
+        raise ValueError(
+            "--dump-voc needs foreground class names; the dataset "
+            f"exposes {tuple(class_names or ())!r} — comp4 det files "
+            "are per-class-NAME"
+        )
     # COCO submissions must carry the ORIGINAL sparse category ids; only
     # the real CocoDataset has the mapping (synthetic/custom ids are
     # already dense → identity).
@@ -352,6 +362,15 @@ def main(argv=None) -> dict:
         coco_results_path=args.dump_coco,
         voc_dets_dir=args.dump_voc,
     )
+
+
+def cli(argv=None) -> int:
+    """Console-script entry point ([project.scripts]).  ``main`` returns
+    its result dict for programmatic callers; returning that from a
+    console script would make ``sys.exit`` treat the truthy dict as a
+    FAILURE exit status, so discard it and return 0 explicitly."""
+    main(argv)
+    return 0
 
 
 if __name__ == "__main__":

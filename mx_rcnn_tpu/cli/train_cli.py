@@ -83,5 +83,14 @@ def main(argv=None) -> dict:
     return metrics
 
 
+def cli(argv=None) -> int:
+    """Console-script entry point ([project.scripts]).  ``main`` returns
+    its result dict for programmatic callers; returning that from a
+    console script would make ``sys.exit`` treat the truthy dict as a
+    FAILURE exit status, so discard it and return 0 explicitly."""
+    main(argv)
+    return 0
+
+
 if __name__ == "__main__":
     main()

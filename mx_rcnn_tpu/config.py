@@ -54,6 +54,15 @@ class BackboneConfig:
     # Execute the 7x7/2 RGB stem in space-to-depth form (exact rewrite,
     # 4x denser MXU contraction — models/resnet.py::StemConv).  ResNet only.
     stem_s2d: bool = False
+    # Execute the stem's 3x3/2 max-pool as strided slices + elementwise max
+    # instead of a reduce_window over the worst-laid-out tensor in the net
+    # (models/resnet.py::_maxpool3x3s2_slices; exact, -inf padding both
+    # forms; falls back on odd stem-output dims).  ResNet only.
+    stem_pool_fold: bool = False
+    # Zero-pad C2's 64-wide contractions to the MXU's 128 lanes (exact —
+    # padded channels are zero; params keep canonical shapes).  ResNet
+    # only; self-limiting to C2, the one sub-128-channel stage.
+    c2_pad: bool = False
     # Fold frozen-BN affines into the conv weights: conv(x, W*s) + t, the
     # same math with the multiply riding the existing f32->bf16 weight
     # cast instead of a per-activation multiply-add (measured +1.4 ms
@@ -100,6 +109,15 @@ class RPNConfig:
     # goldens see identical numbers either way.
     topk_impl: str = "exact"
     topk_recall: float = 0.95
+    # Run the weight-shared head over all FPN levels as ONE packed
+    # computation (models/heads.py::RPNHead.packed) instead of five
+    # sequential small-spatial convs (the P2 apply alone measured
+    # 6.6 ms/step).  Exact — identical per-level outputs; the packing is
+    # sliced away before anything downstream.  No-op for single-level
+    # (C4) models; disabled automatically under spatial partitioning
+    # (parallel/step.py::mesh_safe_model_cfg — the packed canvas would
+    # concatenate across height shards).
+    packed_head: bool = True
 
 
 @dataclass(frozen=True)
@@ -291,12 +309,25 @@ def _replace(cfg: Any, **kw: Any) -> Any:
     return dataclasses.replace(cfg, **kw)
 
 
+def _backbone(name: str) -> BackboneConfig:
+    """Preset backbone defaults.  ResNet presets run the TPU layout forms
+    by default — space-to-depth stem, slice-max stem pool, C2 lane padding
+    — all exact rewrites (parity-tested in tests/test_models.py), so mAP
+    and checkpoints are unaffected; only the compiled program changes.
+    VGG has no strided RGB stem to rewrite and keeps the dense forms."""
+    if name.startswith("resnet"):
+        return BackboneConfig(
+            name=name, stem_s2d=True, stem_pool_fold=True, c2_pad=True
+        )
+    return BackboneConfig(name=name)
+
+
 def _c4_model(num_classes: int, backbone: str) -> ModelConfig:
     """Classic C4 recipe: single-level stride-16 features, anchor scales
     (8, 16, 32), ROIAlign on C4, conv5-as-head replaced by a 2-fc head."""
     return ModelConfig(
         num_classes=num_classes,
-        backbone=BackboneConfig(name=backbone),
+        backbone=_backbone(backbone),
         fpn=FPNConfig(enabled=False),
         anchors=AnchorConfig(scales=(8.0, 16.0, 32.0)),
         rpn=RPNConfig(
@@ -313,7 +344,7 @@ def _c4_model(num_classes: int, backbone: str) -> ModelConfig:
 def _fpn_model(num_classes: int, backbone: str, mask: bool = False) -> ModelConfig:
     return ModelConfig(
         num_classes=num_classes,
-        backbone=BackboneConfig(name=backbone),
+        backbone=_backbone(backbone),
         fpn=FPNConfig(enabled=True),
         anchors=AnchorConfig(scales=(8.0,)),
         rpn=RPNConfig(),
@@ -415,7 +446,13 @@ _register(
         name="tiny_synthetic",
         model=_replace(
             _fpn_model(5, "resnet50"),
-            backbone=BackboneConfig(name="resnet50", freeze_stages=0, dtype="float32"),
+            # float32 + nothing frozen for the hermetic CPU programs; the
+            # TPU layout forms stay ON so every tiny-preset test exercises
+            # the production execution paths (exact rewrites — only
+            # intra-conv summation order can differ).
+            backbone=_replace(
+                _backbone("resnet50"), freeze_stages=0, dtype="float32"
+            ),
             rpn=RPNConfig(
                 batch_size=64,
                 train_pre_nms_top_n=200,

@@ -84,8 +84,12 @@ class TwoStageDetector(nn.Module):
         """Per-level RPN outputs: {level: (logits (B, A_l), deltas (B, A_l, 4))}.
 
         One weight-shared head over all levels (FPN paper); for C4 there is
-        only one level.
+        only one level.  ``rpn.packed_head`` runs all levels as one packed
+        computation (models/heads.py::RPNHead.packed — exact, same
+        per-level outputs) instead of len(feats) sequential head applies.
         """
+        if self.cfg.rpn.packed_head and len(feats) > 1:
+            return self.rpn_head.packed(feats)
         return {lvl: self.rpn_head(feats[lvl]) for lvl in sorted(feats)}
 
     def box(self, pooled: jnp.ndarray):

@@ -236,6 +236,16 @@ def _pallas_interpret() -> bool:
 
 def _pool_rois(cfg: ModelConfig, feats, rois, pooled_size: int, roi_level_set,
                mesh=None):
+    # Named scope so per-component cost attribution (utils/hlo_profile.py)
+    # can see the parameter-free ROI stage, which no flax module names.
+    with jax.named_scope("roi_align"):
+        return _pool_rois_impl(
+            cfg, feats, rois, pooled_size, roi_level_set, mesh
+        )
+
+
+def _pool_rois_impl(cfg: ModelConfig, feats, rois, pooled_size: int,
+                    roi_level_set, mesh=None):
     """ROIAlign over the batch. rois: (B, R, 4) -> (B, R, S, S, C).
 
     ``cfg.rcnn.roi_align_impl`` picks the backend: "pallas" (default — ONE

@@ -31,12 +31,16 @@ import numpy as np
 
 def _coco_image_id(image_id: str):
     """COCO image ids are ints; the internal roidb stringifies them.
-    Non-numeric ids (custom datasets) pass through as strings — stock
-    pycocotools indexes results by whatever id type the gt json used."""
+    Convert back only when the round-trip is lossless — ``int("000005")``
+    is 5, and a gt json keyed by the zero-padded string would then never
+    match a single result entry.  Non-numeric and non-canonical ids pass
+    through as strings — stock pycocotools indexes results by whatever id
+    type the gt json used."""
     try:
-        return int(image_id)
+        as_int = int(image_id)
     except ValueError:
         return image_id
+    return as_int if str(as_int) == image_id else image_id
 
 
 def write_coco_results(

@@ -17,7 +17,8 @@ def build_backbone(cfg: BackboneConfig, out_levels: tuple[int, ...] = (2, 3, 4, 
     if cfg.name in STAGE_BLOCKS:
         return ResNet(blocks=STAGE_BLOCKS[cfg.name], norm=cfg.norm, dtype=dtype,
                       out_levels=out_levels, remat=cfg.remat,
-                      stem_s2d=cfg.stem_s2d, fold_bn=cfg.fold_frozen_bn,
+                      stem_s2d=cfg.stem_s2d, stem_pool_fold=cfg.stem_pool_fold,
+                      pad_small_ch=cfg.c2_pad, fold_bn=cfg.fold_frozen_bn,
                       name="backbone")
     if cfg.name == "vgg16":
         if cfg.stem_s2d:

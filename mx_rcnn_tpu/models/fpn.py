@@ -41,5 +41,8 @@ class FPN(nn.Module):
             for lvl in backbone_levels
         }
         for lvl in range(top + 1, self.max_level + 1):
-            out[lvl] = nn.max_pool(out[lvl - 1], (1, 1), strides=(2, 2))
+            # "Max-pool" with a 1x1 window IS stride-2 subsampling; the
+            # strided slice says so directly instead of emitting a
+            # reduce_window over P5 (identical output, trivially fusible).
+            out[lvl] = out[lvl - 1][:, ::2, ::2, :]
         return out

@@ -26,29 +26,87 @@ _init001 = nn.initializers.normal(0.001)
 
 
 class RPNHead(nn.Module):
+    """Weight-shared RPN head with two execution forms.
+
+    ``__call__`` applies the head to ONE level.  ``packed`` applies it to a
+    whole FPN pyramid as a single computation: the per-level feature maps
+    are packed into one canvas (stacked along H, right-padded to the widest
+    level's W, one zero separator row between levels) and the 3x3 conv +
+    objectness/delta 1x1s run ONCE over it instead of once per level — the
+    five sequential small-spatial head dispatches (P2 alone measured
+    6.6 ms/step) become three convs over one well-shaped tensor.  The
+    packing is exact: a 3x3 SAME conv reads at most one row/col past a
+    level's edge, and that row/col is zero both per-level (SAME padding)
+    and in the canvas (separator row / W pad / canvas edge); outputs at
+    separator/pad positions are sliced away.  Cost: the pad region adds
+    ~40% head FLOPs at the recipe pyramid — bought back by issuing one
+    large conv instead of five boundary-dominated small ones.
+
+    Param tree ("conv"/"objectness"/"deltas") is identical for both forms;
+    checkpoints are execution-form independent.
+    """
+
     num_anchors: int
     channels: int = 256
     dtype: jnp.dtype = jnp.bfloat16
 
-    @nn.compact
+    def setup(self):
+        self.conv = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype, kernel_init=_init01, name="conv")
+        self.objectness = nn.Conv(self.num_anchors, (1, 1), dtype=self.dtype,
+                                  kernel_init=_init01, name="objectness")
+        self.deltas = nn.Conv(self.num_anchors * 4, (1, 1), dtype=self.dtype,
+                              kernel_init=_init001, name="deltas")
+
+    def _heads(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        y = nn.relu(self.conv(x))
+        return self.objectness(y), self.deltas(y)
+
     def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
         """x: (B, H, W, C) -> logits (B, H*W*A), deltas (B, H*W*A, 4).
 
         Flattening order is (H, W, A) row-major — anchor generation
         (geometry/anchors.py::shifted_anchors) must match.
         """
-        y = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
-                    dtype=self.dtype, kernel_init=_init01, name="conv")(x)
-        y = nn.relu(y)
-        logits = nn.Conv(self.num_anchors, (1, 1), dtype=self.dtype,
-                         kernel_init=_init01, name="objectness")(y)
-        deltas = nn.Conv(self.num_anchors * 4, (1, 1), dtype=self.dtype,
-                         kernel_init=_init001, name="deltas")(y)
+        logits, deltas = self._heads(x)
         b = x.shape[0]
         return (
             logits.reshape(b, -1).astype(jnp.float32),
             deltas.reshape(b, -1, 4).astype(jnp.float32),
         )
+
+    def packed(
+        self, feats: dict[int, jnp.ndarray]
+    ) -> dict[int, tuple[jnp.ndarray, jnp.ndarray]]:
+        """All levels through one packed head application; per-level
+        outputs (same contract/flattening as looping ``__call__``)."""
+        levels = sorted(feats)
+        if len(levels) == 1:
+            return {levels[0]: self(feats[levels[0]])}
+        b, _, _, c = feats[levels[0]].shape
+        wmax = max(feats[lvl].shape[2] for lvl in levels)
+        zero_row = jnp.zeros((b, 1, wmax, c), feats[levels[0]].dtype)
+        parts, offsets, row = [], {}, 0
+        for i, lvl in enumerate(levels):
+            f = feats[lvl]
+            offsets[lvl] = row
+            parts.append(
+                jnp.pad(f, ((0, 0), (0, 0), (0, wmax - f.shape[2]), (0, 0)))
+            )
+            row += f.shape[1]
+            if i + 1 < len(levels):
+                parts.append(zero_row)
+                row += 1
+        logits, deltas = self._heads(jnp.concatenate(parts, axis=1))
+        out = {}
+        for lvl in levels:
+            h, w = feats[lvl].shape[1], feats[lvl].shape[2]
+            r0 = offsets[lvl]
+            out[lvl] = (
+                logits[:, r0:r0 + h, :w, :].reshape(b, -1).astype(jnp.float32),
+                deltas[:, r0:r0 + h, :w, :].reshape(b, -1, 4).astype(jnp.float32),
+            )
+        return out
 
 
 class BoxHead(nn.Module):

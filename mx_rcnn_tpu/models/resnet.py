@@ -126,6 +126,29 @@ class StemConv(nn.Module):
         )
 
 
+def _maxpool3x3s2_slices(x: jnp.ndarray) -> jnp.ndarray:
+    """The stem's 3x3/stride-2 SAME max-pool as an elementwise max of 9
+    strided slices — numerically exact (both forms pad with -inf), but
+    expressed as shifts+maximum instead of a ``reduce_window`` over the
+    half-resolution 64-channel stem output, the worst-laid-out tensor in
+    the network (64 channels = half the 128-wide vector lanes, huge
+    spatial).  Strided slices fuse into the surrounding elementwise graph;
+    the windowed reduction does not.  Requires even H and W (callers fall
+    back to ``nn.max_pool`` otherwise)."""
+    n, h, w, c = x.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=neg)
+    out = None
+    for dr in range(3):
+        for ds in range(3):
+            part = jax.lax.slice(
+                xp, (0, dr, ds, 0), (n, dr + h - 1, ds + w - 1, c),
+                (1, 2, 2, 1),
+            )
+            out = part if out is None else jnp.maximum(out, part)
+    return out
+
+
 class Bottleneck(nn.Module):
     """1x1 -> 3x3(stride) -> 1x1(x4) with projection shortcut on shape change.
 
@@ -137,6 +160,14 @@ class Bottleneck(nn.Module):
     FrozenBN costs +1.4 ms across an R101 trunk at recipe shapes (it does
     NOT all fuse into the convs, contrary to this file's earlier claim);
     folding removes it.  Param tree identical to the unfused form.
+
+    ``pad_small_ch``: zero-pad sub-128 contraction dims (all of C2's
+    64-wide convs) to the MXU's 128 lanes.  Exact — the padded input
+    channels are zero, so they contribute nothing whatever the padded
+    kernel rows hold — and the lanes were already wasted; padding just
+    makes the layout explicit instead of leaving XLA to re-derive it per
+    fusion.  Params keep their canonical (k, k, 64, ch) shapes; the pad is
+    an in-graph widening of the cast weight.
     """
 
     channels: int  # bottleneck width; output is channels * 4
@@ -144,21 +175,34 @@ class Bottleneck(nn.Module):
     norm: str = "frozen_bn"
     dtype: jnp.dtype = jnp.bfloat16
     fold_bn: bool = False
+    pad_small_ch: bool = False
 
     def _conv_bn(self, x, ch, k, s, cname, bname):
-        if self.fold_bn and self.norm == "frozen_bn":
-            kernel = _ConvKernel((k, k, x.shape[-1], ch), name=cname)()
+        fold = self.fold_bn and self.norm == "frozen_bn"
+        pad = self.pad_small_ch and x.shape[-1] < 128
+        if not (fold or pad):
+            y = nn.Conv(
+                ch, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+                use_bias=False, dtype=self.dtype, name=cname,
+            )(x)
+            return make_norm(self.norm, self.dtype, bname)(y)
+        kernel = _ConvKernel((k, k, x.shape[-1], ch), name=cname)()
+        add = None
+        if fold:
             mul, add = _FrozenBNConsts(name=bname)(ch)
-            y = jax.lax.conv_general_dilated(
-                x, (kernel * mul).astype(self.dtype),
-                window_strides=(s, s), padding=[(k // 2, k // 2)] * 2,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
+            kernel = kernel * mul
+        kernel = kernel.astype(self.dtype)
+        if pad:
+            extra = 128 - x.shape[-1]
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, extra)))
+            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, extra), (0, 0)))
+        y = jax.lax.conv_general_dilated(
+            x, kernel,
+            window_strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if fold:
             return y + add.astype(self.dtype)
-        y = nn.Conv(
-            ch, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
-            use_bias=False, dtype=self.dtype, name=cname,
-        )(x)
         return make_norm(self.norm, self.dtype, bname)(y)
 
     @nn.compact
@@ -190,8 +234,15 @@ class ResNet(nn.Module):
     remat: bool = False
     # Space-to-depth execution of the stem conv (see StemConv).
     stem_s2d: bool = False
+    # Execute the stem's 3x3/2 max-pool as strided slices + maximum
+    # instead of a reduce_window (see _maxpool3x3s2_slices).  Exact;
+    # silently falls back on odd stem-output dims.
+    stem_pool_fold: bool = False
     # Fold frozen-BN affines into the conv weights (see Bottleneck).
     fold_bn: bool = False
+    # Zero-pad C2's 64-wide contractions to the 128 MXU lanes (see
+    # Bottleneck.pad_small_ch).  Self-limiting: stages >= C3 are 128+ wide.
+    pad_small_ch: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> dict[int, jnp.ndarray]:
@@ -208,7 +259,10 @@ class ResNet(nn.Module):
             x = stem(x)
             x = make_norm(self.norm, self.dtype, "bn1")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        if self.stem_pool_fold and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = _maxpool3x3s2_slices(x)
+        else:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
 
         feats: dict[int, jnp.ndarray] = {}
         widths = (64, 128, 256, 512)
@@ -221,6 +275,7 @@ class ResNet(nn.Module):
                     norm=self.norm,
                     dtype=self.dtype,
                     fold_bn=fold,
+                    pad_small_ch=self.pad_small_ch,
                     name=f"layer{i + 1}_block{b}",
                 )(x)
             level = i + 2

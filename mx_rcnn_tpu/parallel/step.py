@@ -138,20 +138,31 @@ def mesh_safe_model_cfg(model_cfg, mesh, spatial: bool = False):
     partitioning (model axis > 1) shards feature-map heights across chips,
     which the per-shard kernel contract doesn't cover, so those runs use
     the XLA form (identical numerics — it is the kernel's oracle).
-    """
-    if (
-        spatial
-        and mesh is not None
-        and mesh.size > 1
-        and model_cfg.rcnn.roi_align_impl == "pallas"
-    ):
-        import dataclasses
 
-        return dataclasses.replace(
-            model_cfg,
-            rcnn=dataclasses.replace(model_cfg.rcnn, roi_align_impl="xla"),
+    The TPU layout forms revert to their dense equivalents under spatial
+    partitioning for the same reason — each reshapes or concatenates along
+    the sharded height axis (s2d stem halves H, the packed RPN head stacks
+    levels along H), turning an exact local rewrite into a cross-shard
+    shuffle.  All are exact either way, so only the compiled program
+    changes.  C2 lane padding widens channels, not height, and stays.
+    """
+    if not (spatial and mesh is not None and mesh.size > 1):
+        return model_cfg
+    import dataclasses
+
+    changed = {}
+    if model_cfg.rcnn.roi_align_impl == "pallas":
+        changed["rcnn"] = dataclasses.replace(
+            model_cfg.rcnn, roi_align_impl="xla"
         )
-    return model_cfg
+    if model_cfg.rpn.packed_head:
+        changed["rpn"] = dataclasses.replace(model_cfg.rpn, packed_head=False)
+    bb = model_cfg.backbone
+    if bb.stem_s2d or bb.stem_pool_fold:
+        changed["backbone"] = dataclasses.replace(
+            bb, stem_s2d=False, stem_pool_fold=False
+        )
+    return dataclasses.replace(model_cfg, **changed) if changed else model_cfg
 
 
 def make_sharded_infer(

@@ -57,7 +57,17 @@ def restore_checkpoint(
         step = mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
-    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)
+    def _abstract(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            # Callers that build the target under jax.eval_shape (eval/demo
+            # drivers) hand leaves whose .sharding is None; this orbax
+            # release unconditionally calls .to_jax_sharding() on it.
+            # Rebuild without the sharding field — restore then places
+            # arrays with its default (single-device) layout.
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return ocp.utils.to_shape_dtype_struct(x)
+
+    abstract = jax.tree_util.tree_map(_abstract, target)
     restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
     mgr.close()
     return restored

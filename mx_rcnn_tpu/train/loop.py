@@ -82,8 +82,9 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
     )
     if model_cfg is not cfg.model:
         log.info(
-            "spatial partitioning: using the XLA ROIAlign (the Pallas "
-            "kernel's shard_map wrap covers the data axis only)"
+            "spatial partitioning: using the XLA ROIAlign and dense "
+            "stem/RPN-head forms (the Pallas kernel's shard_map wrap and "
+            "the height-axis layout rewrites cover unsharded heights only)"
         )
     model = TwoStageDetector(cfg=model_cfg)
     rng = jax.random.PRNGKey(cfg.train.seed)

@@ -1,3 +1,15 @@
+from mx_rcnn_tpu.utils.hlo_profile import (
+    attribute_flops,
+    component_of,
+    hlo_component_summary,
+)
 from mx_rcnn_tpu.utils.profiling import ProfileWindow, StepTimer, trace
 
-__all__ = ["ProfileWindow", "StepTimer", "trace"]
+__all__ = [
+    "ProfileWindow",
+    "StepTimer",
+    "attribute_flops",
+    "component_of",
+    "hlo_component_summary",
+    "trace",
+]

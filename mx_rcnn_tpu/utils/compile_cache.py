@@ -31,6 +31,24 @@ import re
 _FEATURE_RUN = re.compile(rb"[+-][a-z0-9_.\-]+(?:,[+-][a-z0-9_.\-]+){8,}")
 
 
+def _features_from_blob(blob: bytes) -> str:
+    """Cache-key material from a serialized AOT probe executable.
+
+    Preferred: the longest ``+feat,-feat,...`` run — the human-auditable
+    LLVM target-feature string itself.  When the blob format stops
+    embedding it verbatim (jaxlib 0.9.0's serialization does not carry a
+    recognizable run, observed on the bench host), hash the WHOLE blob
+    instead: the codegen'd bytes necessarily differ wherever the target
+    features differ, so the key keeps discriminating exactly the failure
+    mode instead of silently degrading to the cpuinfo proxy that
+    MULTICHIP_r04 showed can collide.
+    """
+    runs = [m.group(0) for m in _FEATURE_RUN.finditer(blob)]
+    if runs:
+        return max(runs, key=len).decode()
+    return "blob:" + hashlib.sha1(blob).hexdigest()
+
+
 def llvm_target_features() -> str | None:
     """The LLVM target-feature string XLA:CPU actually compiles with.
 
@@ -41,31 +59,52 @@ def llvm_target_features() -> str | None:
     (``+prefer-no-scatter,+prefer-no-gather`` present on one host, absent
     on the other) — r4's /proc/cpuinfo proxy demonstrably still collided
     (MULTICHIP_r04 tail), so r5 keys on the decision itself instead of
-    its inputs.  Verified present in the serialized blob on this image
-    (jaxlib 0.8.x: 3.4 KB probe, feature run embedded verbatim).
+    its inputs.  Verified present in the serialized blob on jaxlib 0.8.x
+    (3.4 KB probe, feature run embedded verbatim); jaxlib 0.9.0 blobs no
+    longer embed the run, so ``_features_from_blob`` falls back to a hash
+    of the entire blob — still a fingerprint of the codegen decision, not
+    of its cpuinfo inputs.
 
     Requires an initialized XLA:CPU backend — both callers pin
-    ``jax_platforms`` to cpu before calling.  Returns None if anything in
-    the probe path is unavailable (caller falls back to cpuinfo).
+    ``jax_platforms`` to cpu before calling.  Returns None only if the
+    probe path itself is unavailable (caller falls back to cpuinfo).
     """
     try:
         import jax
-        import jax.numpy as jnp
 
         if jax.default_backend() != "cpu":
             return None
-        probe = (
-            jax.jit(lambda x: x @ x)
-            .lower(jnp.zeros((4, 4), jnp.float32))
-            .compile()
-        )
-        blob = probe.runtime_executable().serialize()
-        runs = [m.group(0) for m in _FEATURE_RUN.finditer(blob)]
-        if not runs:
+        blob = _probe_blob()
+        if blob != _probe_blob():
+            # A cache key must be stable across processes; a serializer
+            # that embeds compile-varying bytes (observed on jaxlib
+            # 0.4.x: two fresh compiles of the same program serialize
+            # differently — module ids) would key every run separately
+            # and the cache would never warm.  Only then fall back to
+            # the cpuinfo proxy.
             return None
-        return max(runs, key=len).decode()
+        return _features_from_blob(blob)
     except Exception:
         return None
+
+
+def _probe_blob() -> bytes:
+    """Compile a fresh trivial executable and serialize it.  A new lambda
+    each call defeats jax's jit cache, so two calls exercise two full
+    compile+serialize rounds — the determinism check above needs that."""
+    import jax
+    import jax.numpy as jnp
+
+    probe = (
+        jax.jit(lambda x: x @ x)
+        .lower(jnp.zeros((4, 4), jnp.float32))
+        .compile()
+    )
+    ex = probe.runtime_executable()
+    if hasattr(ex, "serialize"):
+        return ex.serialize()
+    # Older jaxlibs (0.4.x) expose serialization on the client.
+    return ex.client.serialize_executable(ex)
 
 
 def cpu_fingerprint() -> str:

@@ -228,3 +228,66 @@ class TestAlternateExternalProposals:
         assert os.path.exists(pkl)
         leaves = jax.tree_util.tree_leaves(state.params)
         assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+
+class TestConsoleScripts:
+    """The [project.scripts] entry points must exit 0 on success.  Every
+    CLI ``main`` returns its result dict for programmatic callers, and a
+    console script's return value feeds ``sys.exit`` — a truthy dict
+    means exit status 1, so each script routes through a ``cli`` wrapper
+    that discards the dict."""
+
+    MODULES = ("train_cli", "eval_cli", "demo_cli", "reeval_cli",
+               "alternate_cli")
+
+    def test_pyproject_points_at_wrappers(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "pyproject.toml")) as f:
+            text = f.read()
+        for mod in self.MODULES:
+            assert f"mx_rcnn_tpu.cli.{mod}:cli" in text
+            assert f"mx_rcnn_tpu.cli.{mod}:main" not in text
+
+    @pytest.mark.parametrize("mod_name", MODULES)
+    def test_wrapper_returns_zero_in_process(self, mod_name, monkeypatch):
+        import importlib
+
+        mod = importlib.import_module(f"mx_rcnn_tpu.cli.{mod_name}")
+        seen = {}
+
+        def fake_main(argv=None):
+            seen["argv"] = argv
+            return {"loss": 0.5, "mAP": 0.3}  # truthy, like the real mains
+
+        monkeypatch.setattr(mod, "main", fake_main)
+        rc = mod.cli(["--whatever"])
+        assert rc == 0  # sys.exit(0) == success at the console
+        assert seen["argv"] == ["--whatever"]  # argv forwarded
+
+
+class TestDumpVocUpFrontValidation:
+    def test_fails_before_eval_when_no_class_names(self, monkeypatch):
+        """--dump-voc with a dataset that exposes no class names must
+        raise BEFORE pred_eval's inference pass, on every host."""
+        import types
+
+        import mx_rcnn_tpu.cli.eval_cli as ec
+        import mx_rcnn_tpu.evalutil as ev
+        from mx_rcnn_tpu.train.loop import build_all
+
+        cfg = get_config("tiny_synthetic")
+        model, tx, state, step_fn, gb = build_all(cfg, mesh=None)
+
+        nameless = types.SimpleNamespace()  # no .classes attr
+        monkeypatch.setattr(
+            ec, "_eval_loader", lambda *a, **k: (nameless, [], iter(()))
+        )
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "pred_eval reached despite an invalid --dump-voc"
+            )
+
+        monkeypatch.setattr(ev, "pred_eval", boom)
+        with pytest.raises(ValueError, match="foreground class names"):
+            ec.run_eval(cfg, state=state, voc_dets_dir="/tmp/nowhere")

@@ -500,3 +500,34 @@ class TestSubmissionFormats:
         ours = evaluate_detections(per_image, roidb, num_classes=4, style="coco")
         assert ours["AP"] == pytest.approx(ev.stats[0], abs=1e-3)
         assert ours["AP50"] == pytest.approx(ev.stats[1], abs=1e-3)
+
+
+class TestCocoImageIdLossless:
+    def test_zero_padded_ids_survive(self, tmp_path):
+        """VOC-style zero-padded ids ("000005") must NOT be int-ified —
+        ``int("000005")`` is 5, and a gt json keyed by the padded string
+        would match zero result entries (silent AP=0)."""
+        from mx_rcnn_tpu.evalutil.submission import _coco_image_id
+
+        assert _coco_image_id("000005") == "000005"  # lossy -> passthrough
+        assert _coco_image_id("5") == 5  # canonical -> int
+        assert _coco_image_id("-3") == -3
+        assert _coco_image_id("img_001") == "img_001"  # non-numeric
+
+        # And through the writer: the wire file carries the exact id.
+        import json
+
+        from mx_rcnn_tpu.evalutil import write_coco_results
+
+        per_image = {
+            "000005": {
+                "boxes": np.asarray([[1.0, 2.0, 10.0, 12.0]], np.float32),
+                "scores": np.asarray([0.9], np.float32),
+                "classes": np.asarray([1], np.int32),
+            }
+        }
+        path = str(tmp_path / "results.json")
+        write_coco_results(path, per_image, None)
+        with open(path) as f:
+            (entry,) = json.load(f)
+        assert entry["image_id"] == "000005"

@@ -305,3 +305,120 @@ class TestFoldedFrozenBN:
         m2 = build_backbone(dataclasses.replace(cfg, norm="gn"))
         x = jnp.zeros((1, 32, 32, 3))
         m2.init(jax.random.PRNGKey(0), x)  # must not raise
+
+
+class TestTpuLayoutForms:
+    """The stem/C2/RPN-head layout rewrites are EXACT algebraic
+    transformations — every test here pins a rewritten form against its
+    dense reference with identical weights (and an identical param tree,
+    so checkpoints and the torch importer never see the layout)."""
+
+    def _resnet(self, **kw):
+        return ResNet(blocks=STAGE_BLOCKS["resnet50"], dtype=jnp.float32, **kw)
+
+    def test_pool_fold_bit_exact(self):
+        from flax import linen as nn
+
+        from mx_rcnn_tpu.models.resnet import _maxpool3x3s2_slices
+
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 24, 8),
+                        jnp.float32)
+        # The torch-style symmetric (1, 1) pad the stem uses — NOT XLA
+        # "SAME", which pads (0, 1) for this even-size/stride-2 case.
+        ref = nn.max_pool(x, (3, 3), strides=(2, 2),
+                          padding=[(1, 1), (1, 1)])
+        np.testing.assert_array_equal(np.asarray(_maxpool3x3s2_slices(x)),
+                                      np.asarray(ref))
+
+    def test_pool_fold_odd_canvas_falls_back(self):
+        # Odd feature heights (possible through exotic canvas overrides)
+        # must not break the backbone — the fold silently yields to
+        # nn.max_pool.
+        m = self._resnet(stem_pool_fold=True, out_levels=(2,))
+        x = jnp.zeros((1, 66, 66, 3))  # stem output 33x33: odd
+        v = m.init(jax.random.PRNGKey(0), x)
+        assert m.apply(v, x)[2].shape == (1, 17, 17, 256)
+
+    def test_backbone_all_layout_flags_parity(self):
+        # stem_s2d + stem_pool_fold + pad_small_ch together vs the dense
+        # backbone: same param tree, same outputs (f32; only intra-conv
+        # summation order may differ).
+        m0 = self._resnet()
+        m1 = self._resnet(stem_s2d=True, stem_pool_fold=True,
+                          pad_small_ch=True)
+        x = jnp.asarray(np.random.RandomState(7).randn(2, 64, 96, 3),
+                        jnp.float32)
+        v0 = m0.init(jax.random.PRNGKey(0), x)
+        v1 = m1.init(jax.random.PRNGKey(0), x)
+        assert (jax.tree_util.tree_structure(v0)
+                == jax.tree_util.tree_structure(v1))
+        f0, f1 = m0.apply(v0, x), m1.apply(v0, x)
+        for lvl in f0:
+            np.testing.assert_allclose(f0[lvl], f1[lvl], rtol=1e-5,
+                                       atol=1e-4)
+
+    def test_c2_pad_zero_lanes_are_exact(self):
+        # Lane padding alone (no stem rewrite): padded input channels are
+        # zero, padded kernel rows are zero — the contraction is the same
+        # sum plus zeros.
+        m0, m1 = self._resnet(), self._resnet(pad_small_ch=True)
+        x = jnp.asarray(np.random.RandomState(11).randn(1, 32, 32, 3),
+                        jnp.float32)
+        v = m0.init(jax.random.PRNGKey(1), x)
+        f0, f1 = m0.apply(v, x), m1.apply(v, x)
+        for lvl in f0:
+            np.testing.assert_allclose(f0[lvl], f1[lvl], rtol=1e-6,
+                                       atol=1e-5)
+
+    def test_packed_rpn_head_matches_sequential(self):
+        # One packed canvas vs five per-level calls, same weights.  The 3x3
+        # SAME conv reads at most one row past each level's edge — a zero
+        # separator row / zero W-pad, matching the per-level zero padding —
+        # so the sliced-out results are the sequential ones.
+        m = RPNHead(num_anchors=3, channels=32, dtype=jnp.float32)
+        rng = np.random.RandomState(5)
+        feats = {
+            lvl: jnp.asarray(
+                rng.randn(2, 64 >> (lvl - 2), 96 >> (lvl - 2), 16),
+                jnp.float32)
+            for lvl in (2, 3, 4, 5, 6)
+        }
+        v = m.init(jax.random.PRNGKey(0), feats[2])
+        packed = m.apply(v, feats, method="packed")
+        assert set(packed) == set(feats)
+        for lvl, f in feats.items():
+            logits, deltas = m.apply(v, f)
+            np.testing.assert_allclose(packed[lvl][0], logits, rtol=1e-6,
+                                       atol=1e-6)
+            np.testing.assert_allclose(packed[lvl][1], deltas, rtol=1e-6,
+                                       atol=1e-6)
+
+    def test_packed_single_level_passthrough(self):
+        m = RPNHead(num_anchors=3, channels=32, dtype=jnp.float32)
+        f = jnp.asarray(np.random.RandomState(2).randn(1, 8, 8, 16),
+                        jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), f)
+        packed = m.apply(v, {4: f}, method="packed")
+        logits, deltas = m.apply(v, f)
+        np.testing.assert_array_equal(np.asarray(packed[4][0]),
+                                      np.asarray(logits))
+        np.testing.assert_array_equal(np.asarray(packed[4][1]),
+                                      np.asarray(deltas))
+
+    def test_mesh_safe_cfg_reverts_height_axis_forms(self):
+        import types
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
+
+        cfg = get_config("r50_fpn_coco").model
+        assert cfg.backbone.stem_s2d and cfg.rpn.packed_head  # defaults ON
+        mesh = types.SimpleNamespace(size=4)
+        safe = mesh_safe_model_cfg(cfg, mesh, spatial=True)
+        assert not safe.backbone.stem_s2d
+        assert not safe.backbone.stem_pool_fold
+        assert not safe.rpn.packed_head
+        # Channel-axis padding doesn't touch the sharded height axis.
+        assert safe.backbone.c2_pad == cfg.backbone.c2_pad
+        # Non-spatial meshes keep every layout form.
+        assert mesh_safe_model_cfg(cfg, mesh, spatial=False) is cfg
