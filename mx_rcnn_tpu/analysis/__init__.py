@@ -1,0 +1,61 @@
+"""tpulint: static analysis that proves the train/eval steps are TPU-clean.
+
+The paper's premise is that the whole MXNet/CUDA execution path becomes a
+single XLA computation with no hidden host round-trips.  This package is
+the machinery that *checks* that claim instead of assuming it:
+
+* :mod:`ast_lint` (layer 1) — repo-aware AST rules over the package source:
+  host-sync casts on traced values, raw numpy inside jit-traced code,
+  Python branching on tracer values, dict-ordering-dependent trace inputs,
+  and MXU-emitting code outside any ``jax.named_scope``/flax-module scope
+  (which would fall into hlo_profile's "other" bucket).  Pre-existing
+  violations are frozen in a committed baseline file; new ones fail.
+
+* :mod:`jaxpr_checks` (layer 2) — abstractly trace the *actual* jitted
+  train/eval/proposal steps under ``JAX_PLATFORMS=cpu`` and machine-verify
+  the TPU invariants: zero f64/i64 in the traced programs, a
+  ``jax.transfer_guard("disallow")``-clean steady-state step, double-trace
+  determinism (the recompilation guard), buffer donation actually applied
+  to the train state, and >=99% of conv/dot FLOPs attributed to a named
+  component by :mod:`mx_rcnn_tpu.utils.hlo_profile`.
+
+``tools/tpulint.py`` is the CLI (writes ``artifacts/tpulint_report.json``);
+``tests/test_tpulint.py`` runs both layers as tier-1 tests.  See
+``docs/static_analysis.md`` for the rule set and extension guide.
+"""
+
+from mx_rcnn_tpu.analysis.ast_lint import (
+    Finding,
+    RULES,
+    TRACED_PREFIXES,
+    lint_paths,
+    lint_source,
+    traced_files,
+)
+from mx_rcnn_tpu.analysis.baseline import (
+    collect_counts,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from mx_rcnn_tpu.analysis.jaxpr_checks import (
+    CheckResult,
+    build_programs,
+    run_jaxpr_checks,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "TRACED_PREFIXES",
+    "lint_paths",
+    "lint_source",
+    "traced_files",
+    "collect_counts",
+    "load_baseline",
+    "new_findings",
+    "write_baseline",
+    "CheckResult",
+    "build_programs",
+    "run_jaxpr_checks",
+]

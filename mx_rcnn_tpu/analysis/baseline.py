@@ -1,0 +1,104 @@
+"""Baseline / suppression file for the AST lint layer.
+
+The repo predates tpulint, so layer 1 finds violations that were reviewed
+and found harmless (host-side constant math in traced files, Pallas kernel
+bodies whose FLOPs are attributed by the caller's scope, ...).  Freezing
+them in a committed file turns the lint into a ratchet: the frozen set can
+only shrink, and any NEW finding — a new fingerprint, or more occurrences
+of a frozen one — fails ``tools/tpulint.py --check``.
+
+Format (``tpulint_baseline.json``): human-auditable JSON —
+
+    {"version": 1,
+     "suppressions": {
+        "<fingerprint>": {"rule": ..., "path": ..., "snippet": ...,
+                          "count": N}}}
+
+The fingerprint is sha1(rule:path:stripped-line)[:12] (ast_lint.Finding),
+so reformatting or moving a line does not churn the file, while editing
+the line re-opens the finding for review.  Regenerate with
+``python tools/tpulint.py --write-baseline`` (then review the diff — a
+baseline refresh is a statement that every new entry was human-judged
+acceptable).
+
+Layer 2 (jaxpr invariants) has NO suppression mechanism by design: the
+traced-program invariants must hold outright.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from mx_rcnn_tpu.analysis.ast_lint import Finding
+
+BASELINE_VERSION = 1
+
+
+def collect_counts(findings: Iterable[Finding]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> dict:
+    """Load a baseline file; missing file = empty baseline (everything is
+    a new finding)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {"version": BASELINE_VERSION, "suppressions": {}}
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {data.get('version')!r} != "
+            f"{BASELINE_VERSION}; regenerate with --write-baseline"
+        )
+    return data
+
+
+def new_findings(
+    findings: Iterable[Finding], baseline: dict
+) -> list[Finding]:
+    """Findings beyond the baseline's per-fingerprint counts.
+
+    Occurrence semantics: a baseline count of N suppresses the first N
+    occurrences of that fingerprint; the N+1'th is new.  Order within a
+    fingerprint follows (path, line) so the reported "new" one is the
+    last-added in source order.
+    """
+    budget = {
+        fp: entry.get("count", 1)
+        for fp, entry in baseline.get("suppressions", {}).items()
+    }
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> dict:
+    """Freeze the given findings as the new baseline; returns the data."""
+    entries: dict[str, dict] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        fp = f.fingerprint()
+        if fp in entries:
+            entries[fp]["count"] += 1
+        else:
+            entries[fp] = {
+                "rule": f.rule,
+                "path": f.path,
+                "snippet": f.snippet,
+                "count": 1,
+            }
+    data = {"version": BASELINE_VERSION, "suppressions": entries}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data

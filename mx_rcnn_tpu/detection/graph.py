@@ -112,6 +112,11 @@ def _rpn_losses(rpn_logits, rpn_deltas, targets):
     smooth_l1(sigma=3) on fg anchors normalized by the same count
     (reference grad_scale = 1/RPN_BATCH_SIZE per image).
     """
+    with jax.named_scope("rpn_loss"):
+        return _rpn_losses_impl(rpn_logits, rpn_deltas, targets)
+
+
+def _rpn_losses_impl(rpn_logits, rpn_deltas, targets):
     labels = targets.labels            # (B, A) 1/0/-1
     valid = targets.valid_mask         # (B, A)
     fg = targets.fg_mask               # (B, A)
@@ -143,6 +148,12 @@ def _rcnn_losses(cls_logits, box_deltas, samples, class_agnostic: bool):
     flattened samples.  Matches the reference's SoftmaxOutput
     (normalization='valid') + smooth_l1(sigma=1) scaled 1/BATCH_ROIS.
     """
+    with jax.named_scope("rcnn_loss"):
+        return _rcnn_losses_impl(cls_logits, box_deltas, samples,
+                                 class_agnostic)
+
+
+def _rcnn_losses_impl(cls_logits, box_deltas, samples, class_agnostic: bool):
     labels = samples.labels.reshape(-1)            # (N,)
     weights = samples.label_weights.reshape(-1)    # (N,)
     fg = samples.fg_mask.reshape(-1)               # (N,)
@@ -380,6 +391,13 @@ def _mask_loss(mask_logits, samples, gt_masks, gt_boxes, resolution: int):
     mask_logits: (B_rois, M, M, C); averaged over fg rois x pixels
     (Mask R-CNN: the loss is defined only on positives' own class channel).
     """
+    with jax.named_scope("mask_loss"):
+        return _mask_loss_impl(
+            mask_logits, samples, gt_masks, gt_boxes, resolution
+        )
+
+
+def _mask_loss_impl(mask_logits, samples, gt_masks, gt_boxes, resolution: int):
     targets = crop_gt_masks(
         gt_masks, gt_boxes, samples.gt_indices, samples.rois, resolution
     )                                                    # (B, M, M)
@@ -434,9 +452,10 @@ def prep_images(images: jnp.ndarray, pixel_stats=None) -> jnp.ndarray:
 
     mean = np.asarray(pixel_stats[0], np.float32)
     inv_std = np.float32(1.0) / np.asarray(pixel_stats[1], np.float32)
-    return (images.astype(jnp.float32) - jnp.asarray(mean)) * jnp.asarray(
-        inv_std
-    )
+    with jax.named_scope("prep_images"):
+        return (
+            images.astype(jnp.float32) - jnp.asarray(mean)
+        ) * jnp.asarray(inv_std)
 
 
 def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: int = 1):
@@ -485,18 +504,19 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         deltas_cat = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)
         anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)
 
-        targets = jax.vmap(
-            lambda k, gt, gv, gi, hw: assign_anchors_cfg(
-                cfg, k, anchors_cat, gt, gv, hw[0], hw[1], gt_ignore=gi
-            ),
-            in_axes=(0, 0, 0, gi_axis, 0),
-        )(
-            jax.random.split(rng_assign, b),
-            batch.gt_boxes,
-            batch.gt_valid,
-            gt_ignore,
-            batch.image_hw,
-        )
+        with jax.named_scope("assign_anchors"):
+            targets = jax.vmap(
+                lambda k, gt, gv, gi, hw: assign_anchors_cfg(
+                    cfg, k, anchors_cat, gt, gv, hw[0], hw[1], gt_ignore=gi
+                ),
+                in_axes=(0, 0, 0, gi_axis, 0),
+            )(
+                jax.random.split(rng_assign, b),
+                batch.gt_boxes,
+                batch.gt_valid,
+                gt_ignore,
+                batch.image_hw,
+            )
 
         rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
 
@@ -506,35 +526,37 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
         # Proposals are detached: the reference never backprops through the
         # Proposal op either (CustomOp forward-only); gradients reach the
         # RPN exclusively through its losses.
-        scores = jax.nn.sigmoid(lax.stop_gradient(logits_cat))
-        deltas_sg = lax.stop_gradient(deltas_cat)
-        propose = _propose_one(cfg, train=True)
-        props = jax.vmap(
-            lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
-        )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
+        with jax.named_scope("proposals"):
+            scores = jax.nn.sigmoid(lax.stop_gradient(logits_cat))
+            deltas_sg = lax.stop_gradient(deltas_cat)
+            propose = _propose_one(cfg, train=True)
+            props = jax.vmap(
+                lambda s_row, d_row, hw: propose(*_slice_levels(levels, anchors, s_row, d_row), hw)
+            )(scores, deltas_sg, batch.image_hw)  # Proposals (B, R, ...)
         prop_rois, prop_valid = props.rois, props.valid
 
-    samples = jax.vmap(
-        lambda k, rois, rv, gt, gc, gv, gi: sample_rois(
-            k, rois, rv, gt, gc, gv,
-            batch_size=cfg.rcnn.roi_batch_size,
-            fg_fraction=cfg.rcnn.fg_fraction,
-            fg_iou=cfg.rcnn.fg_iou,
-            bg_iou_hi=cfg.rcnn.bg_iou_hi,
-            bg_iou_lo=cfg.rcnn.bg_iou_lo,
-            bbox_weights=cfg.rcnn.bbox_weights,
-            gt_ignore=gi,
-        ),
-        in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
-    )(
-        jax.random.split(rng_sample, b),
-        prop_rois,
-        prop_valid,
-        batch.gt_boxes,
-        batch.gt_classes.astype(jnp.int32),
-        batch.gt_valid,
-        gt_ignore,
-    )
+    with jax.named_scope("sample_rois"):
+        samples = jax.vmap(
+            lambda k, rois, rv, gt, gc, gv, gi: sample_rois(
+                k, rois, rv, gt, gc, gv,
+                batch_size=cfg.rcnn.roi_batch_size,
+                fg_fraction=cfg.rcnn.fg_fraction,
+                fg_iou=cfg.rcnn.fg_iou,
+                bg_iou_hi=cfg.rcnn.bg_iou_hi,
+                bg_iou_lo=cfg.rcnn.bg_iou_lo,
+                bbox_weights=cfg.rcnn.bbox_weights,
+                gt_ignore=gi,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
+        )(
+            jax.random.split(rng_sample, b),
+            prop_rois,
+            prop_valid,
+            batch.gt_boxes,
+            batch.gt_classes.astype(jnp.int32),
+            batch.gt_valid,
+            gt_ignore,
+        )
 
     pooled = _pool_rois(
         cfg, feats, samples.rois, cfg.rcnn.pooled_size, model.roi_levels,

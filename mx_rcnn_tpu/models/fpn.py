@@ -30,11 +30,14 @@ class FPN(nn.Module):
         }
         top = max(backbone_levels)
         merged = {top: laterals[top]}
-        for lvl in sorted(backbone_levels[:-1], reverse=True):
-            up = merged[lvl + 1]
-            b, h, w, c = up.shape
-            up = jax.image.resize(up, (b, h * 2, w * 2, c), method="nearest")
-            merged[lvl] = laterals[lvl] + up
+        with jax.named_scope("fpn_topdown"):
+            for lvl in sorted(backbone_levels[:-1], reverse=True):
+                up = merged[lvl + 1]
+                b, h, w, c = up.shape
+                up = jax.image.resize(
+                    up, (b, h * 2, w * 2, c), method="nearest"
+                )
+                merged[lvl] = laterals[lvl] + up
         out = {
             lvl: nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
                          dtype=self.dtype, name=f"output{lvl}")(merged[lvl])

@@ -86,7 +86,8 @@ def make_train_step(
             return total, metrics
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-        new_state = state.apply_gradients(grads, tx)
+        with jax.named_scope("optimizer"):
+            new_state = state.apply_gradients(grads, tx)
         if schedule is not None:
             metrics = dict(metrics, lr=schedule(state.step))
         return new_state, metrics

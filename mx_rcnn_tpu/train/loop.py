@@ -11,7 +11,9 @@ where the reference re-implements the loop per tool
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -31,7 +33,7 @@ from mx_rcnn_tpu.train.checkpoint import latest_step, restore_checkpoint, save_c
 from mx_rcnn_tpu.train.metrics import (
     ScalarWriter,
     Speedometer,
-    device_metrics_to_host,
+    host_mean_metrics,
 )
 from mx_rcnn_tpu.train.optim import frozen_mask, make_optimizer
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
@@ -335,13 +337,29 @@ def train(
     p0 += -p0 % k
     p1 = max(p1 + (-p1 % k), p0 + k)
     profiler = ProfileWindow(profile_dir, p0, p1)
+    # Hot-path hygiene, machine-enforced (tools/tpulint.py checks the same
+    # invariant on the isolated step): after the first iteration compiles
+    # the program (trace-time constant transfers are expected then), every
+    # step runs under transfer_guard — any implicit host sync that creeps
+    # into the loop raises instead of silently serializing the pipeline.
+    # Metrics stay on device in `pending`; ONE device_get per log interval.
+    guard_mode = os.environ.get("MX_RCNN_TRANSFER_GUARD", "disallow")
+    pending: list[dict] = []
     for i in range(start, steps, k):
         profiler.step(i, sync=state.params)
-        batch = next(it)
-        state, metrics = step_fn(state, batch)
+        guard = (
+            jax.transfer_guard(guard_mode)
+            if i != start and guard_mode != "off"
+            else contextlib.nullcontext()
+        )
+        with guard:
+            batch = next(it)
+            state, metrics = step_fn(state, batch)
+        pending.append(metrics)
         done = i + k
         if done % cfg.train.log_every < k or i == start:
-            host_metrics = device_metrics_to_host(metrics)
+            host_metrics = host_mean_metrics(pending)
+            pending.clear()
             speedo(done, host_metrics)
             if writer:
                 writer.write(done, host_metrics)

@@ -55,6 +55,22 @@ def device_metrics_to_host(metrics: dict) -> dict[str, float]:
     return {k: float(np.asarray(v)) for k, v in flat.items()}
 
 
+def host_mean_metrics(pending: list[dict]) -> dict[str, float]:
+    """Mean metrics over a log interval, fetched in ONE device_get.
+
+    The train loop appends each call's (device-resident) metric dict to
+    ``pending`` and only calls this at log points — the hot path never
+    blocks on a host transfer, and the logged figure is the interval mean
+    rather than a single call's snapshot.  ``lr`` reports the interval's
+    last value (a schedule read, not a statistic)."""
+    flat = jax.device_get(pending)
+    out: dict[str, float] = {}
+    for k in flat[-1]:
+        vals = [float(np.asarray(d[k])) for d in flat if k in d]
+        out[k] = vals[-1] if k == "lr" else sum(vals) / len(vals)
+    return out
+
+
 class ScalarWriter:
     """Append-only jsonl scalar log (one record per log point).
 

@@ -44,11 +44,21 @@ COMPONENT_PATTERNS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("C3", ("backbone/layer2_",)),
     ("C4", ("backbone/layer3_",)),
     ("C5", ("backbone/layer4_",)),
-    ("FPN", ("/fpn/", "fpn/lateral", "fpn/output")),
+    ("FPN", ("/fpn/", "fpn/lateral", "fpn/output", "fpn_topdown")),
     ("RPN-head", ("rpn.packed", "rpn._heads", "/rpn/", ".rpn)")),
     ("ROI", ("roi_align",)),
     ("box-head", ("box_head",)),
     ("mask-head", ("mask_head",)),
+    # Parameter-free stages, tagged via jax.named_scope in graph.py /
+    # parallel/step.py so tools/tpulint.py's flop_attribution invariant
+    # (and the HLO texture) has no silent "other" bucket.
+    ("RPN-loss", ("rpn_loss",)),
+    ("RCNN-loss", ("rcnn_loss",)),
+    ("mask-loss", ("mask_loss",)),
+    ("proposals", ("proposals",)),
+    ("sampling", ("sample_rois", "assign_anchors")),
+    ("preprocess", ("prep_images",)),
+    ("optimizer", ("optimizer",)),
 )
 
 _DECORATIONS = re.compile(
@@ -58,7 +68,8 @@ _DECORATIONS = re.compile(
 
 def component_of(name_stack: str) -> str:
     """Model component for a jaxpr/HLO name stack; ``other`` if unmatched
-    (optimizer update, losses, box encode/decode — all matmul-free)."""
+    (everything FLOP-bearing is scoped — ``other`` should stay ~empty;
+    tools/tpulint.py enforces >=99% attribution on the train step)."""
     s = _DECORATIONS.sub("", str(name_stack)).replace(")", "")
     for comp, pats in COMPONENT_PATTERNS:
         if any(p in s for p in pats):

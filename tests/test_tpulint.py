@@ -1,0 +1,270 @@
+"""tpulint gate: both static-analysis layers run tier-1, CPU-only.
+
+Unit tests pin each AST rule's fire/no-fire behavior on synthetic
+snippets; the repo-level tests are the actual gate — the working tree must
+be clean against the committed baseline, and every jaxpr invariant must
+hold on the real traced programs.  The x64-drift tests cover the two ways
+a float64 has historically crept into JAX training states (host-side
+init, checkpoint import).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.analysis import (
+    build_programs,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    new_findings,
+    run_jaxpr_checks,
+    write_baseline,
+)
+from mx_rcnn_tpu.analysis.jaxpr_checks import ALL_CHECKS
+
+pytestmark = pytest.mark.tpulint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "tpulint_baseline.json")
+# Any path under a traced prefix works for snippet tests.
+TRACED = "mx_rcnn_tpu/detection/_snippet.py"
+
+HEADER = "import numpy as np\nimport jax\nimport jax.numpy as jnp\nfrom jax import lax\n"
+
+
+def rules_of(src, path=TRACED):
+    return [f.rule for f in lint_source(HEADER + src, path)]
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: AST rules
+
+
+class TestAstRules:
+    def test_host_cast_on_value_fires(self):
+        assert rules_of("def f(x):\n    return float(x)\n") == ["TPU001"]
+
+    def test_cast_of_literal_exempt(self):
+        assert rules_of("SCALE = float(16 * 2)\nN = int(-3)\n") == []
+
+    def test_item_and_tolist_fire(self):
+        src = "def f(x):\n    a = x.item()\n    b = x.tolist()\n    return a, b\n"
+        assert rules_of(src) == ["TPU001", "TPU001"]
+
+    def test_np_asarray_fires_as_host_cast(self):
+        assert rules_of("def f(x):\n    return np.asarray(x)\n") == ["TPU001"]
+
+    def test_np_computation_fires(self):
+        assert rules_of("def f(x):\n    return np.sqrt(x)\n") == ["TPU002"]
+
+    def test_np_dtype_attr_exempt(self):
+        assert rules_of("def f(x):\n    return x.astype(np.float32)\n") == []
+
+    def test_branch_on_jnp_expression_fires(self):
+        src = "def f(x):\n    if jnp.any(x > 0):\n        return x\n    return -x\n"
+        assert rules_of(src) == ["TPU003"]
+
+    def test_branch_on_python_value_exempt(self):
+        assert rules_of("def f(x, n):\n    if n > 0:\n        return x\n    return -x\n") == []
+
+    def test_unsorted_dict_iteration_fires(self):
+        src = "def f(d):\n    return [v for k, v in d.items()]\n"
+        assert rules_of(src) == ["TPU004"]
+
+    def test_sorted_dict_iteration_exempt(self):
+        src = "def f(d):\n    return [v for k, v in sorted(d.items())]\n"
+        assert rules_of(src) == []
+
+    def test_unscoped_mxu_op_fires(self):
+        assert rules_of("def f(a, b):\n    return jnp.dot(a, b)\n") == ["TPU005"]
+
+    def test_named_scope_exempts_mxu_op(self):
+        src = (
+            "def f(a, b):\n"
+            "    with jax.named_scope('proj'):\n"
+            "        return jnp.dot(a, b)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_flax_module_exempts_mxu_op(self):
+        src = (
+            "from flax import linen as nn\n"
+            "class Proj(nn.Module):\n"
+            "    def __call__(self, a, b):\n"
+            "        return a @ b\n"
+        )
+        assert rules_of(src) == []
+
+    def test_matmul_operator_fires(self):
+        assert rules_of("def f(a, b):\n    return a @ b\n") == ["TPU005"]
+
+    def test_non_traced_path_is_exempt(self):
+        src = "def f(x):\n    return float(np.sqrt(x))\n"
+        assert lint_source(HEADER + src, "mx_rcnn_tpu/data/loader.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet semantics
+
+
+class TestBaseline:
+    def _findings(self, src):
+        return lint_source(HEADER + src, TRACED)
+
+    def test_roundtrip_suppresses(self, tmp_path):
+        f = self._findings("def f(x):\n    return float(x)\n")
+        path = str(tmp_path / "b.json")
+        write_baseline(path, f)
+        assert new_findings(f, load_baseline(path)) == []
+
+    def test_line_move_stays_suppressed(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        write_baseline(path, self._findings("def f(x):\n    return float(x)\n"))
+        moved = self._findings("# comment\n\ndef f(x):\n    return float(x)\n")
+        assert new_findings(moved, load_baseline(path)) == []
+
+    def test_extra_occurrence_is_new(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        write_baseline(path, self._findings("def f(x):\n    return float(x)\n"))
+        doubled = self._findings(
+            "def f(x):\n    return float(x)\ndef g(x):\n    return float(x)\n"
+        )
+        assert len(new_findings(doubled, load_baseline(path))) == 1
+
+    def test_edited_line_is_new(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        write_baseline(path, self._findings("def f(x):\n    return float(x)\n"))
+        edited = self._findings("def f(x):\n    return float(x.sum())\n")
+        assert len(new_findings(edited, load_baseline(path))) == 1
+
+    def test_missing_baseline_means_everything_new(self, tmp_path):
+        f = self._findings("def f(x):\n    return float(x)\n")
+        empty = load_baseline(str(tmp_path / "absent.json"))
+        assert len(new_findings(f, empty)) == 1
+
+    def test_version_mismatch_raises(self, tmp_path):
+        path = tmp_path / "b.json"
+        path.write_text('{"version": 99, "suppressions": {}}')
+        with pytest.raises(ValueError, match="version"):
+            load_baseline(str(path))
+
+
+# ---------------------------------------------------------------------------
+# Repo-level gate
+
+
+class TestRepoGate:
+    def test_working_tree_clean_against_baseline(self):
+        findings = lint_paths(REPO_ROOT)
+        new = new_findings(findings, load_baseline(BASELINE))
+        assert not new, "new lint findings beyond tpulint_baseline.json:\n" + "\n".join(
+            f.format() for f in new
+        )
+
+    def test_seeded_violation_is_caught(self):
+        path = os.path.join(REPO_ROOT, "mx_rcnn_tpu/detection/graph.py")
+        with open(path) as f:
+            src = f.read()
+        seeded = src + "\n\ndef _seeded(x):\n    return float(x.sum())\n"
+        findings = lint_source(seeded, "mx_rcnn_tpu/detection/graph.py")
+        new = new_findings(findings, load_baseline(BASELINE))
+        assert [f.rule for f in new] == ["TPU001"]
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: jaxpr invariants on the real programs
+
+
+@pytest.fixture(scope="module")
+def programs():
+    return build_programs("tiny_synthetic")
+
+
+@pytest.mark.parametrize("check", ALL_CHECKS, ids=lambda c: c.__name__)
+def test_jaxpr_invariant(programs, check):
+    r = check(programs)
+    assert r.ok, f"{r.name}: {r.detail}"
+
+
+def test_run_jaxpr_checks_reports_every_check(programs):
+    results = run_jaxpr_checks("tiny_synthetic", programs)
+    assert [r.name for r in results] == [
+        c.__name__.removeprefix("check_") for c in ALL_CHECKS
+    ]
+    assert all(r.ok for r in results), [
+        (r.name, r.detail) for r in results if not r.ok
+    ]
+
+
+# ---------------------------------------------------------------------------
+# x64 drift
+
+
+def _wide_leaves(tree):
+    import jax
+
+    return [
+        str(np.asarray(leaf).dtype)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if str(np.asarray(leaf).dtype) in ("float64", "int64")
+    ]
+
+
+def test_create_train_state_has_no_x64_leaves(programs):
+    state = programs.state
+    assert _wide_leaves(state.params) == []
+    assert _wide_leaves(state.opt_state) == []
+    assert _wide_leaves(state.model_state) == []
+
+
+def _fake_resnet_stem_sd(dtype):
+    return {
+        "conv1.weight": np.ones((4, 3, 7, 7), dtype),
+        "bn1.weight": np.ones((4,), dtype),
+        "bn1.bias": np.zeros((4,), dtype),
+        "bn1.running_mean": np.zeros((4,), dtype),
+        "bn1.running_var": np.ones((4,), dtype),
+    }
+
+
+def test_map_torch_resnet_casts_f64_to_f32():
+    from mx_rcnn_tpu.train.import_torch import map_torch_resnet
+
+    params, constants = map_torch_resnet(_fake_resnet_stem_sd(np.float64))
+    assert _wide_leaves(params) == []
+    assert _wide_leaves(constants) == []
+    assert params["conv1"]["kernel"].shape == (7, 7, 3, 4)
+
+
+def test_load_pretrained_backbone_preserves_model_dtypes(tmp_path):
+    torch = pytest.importorskip("torch")
+    from mx_rcnn_tpu.train.import_torch import load_pretrained_backbone
+
+    sd = {
+        k: torch.from_numpy(v)
+        for k, v in _fake_resnet_stem_sd(np.float64).items()
+    }
+    path = str(tmp_path / "stem.pth")
+    torch.save(sd, path)
+    variables = {
+        "params": {
+            "backbone": {"conv1": {"kernel": np.zeros((7, 7, 3, 4), np.float32)}}
+        },
+        "constants": {
+            "backbone": {
+                "bn1": {
+                    "scale": np.zeros((4,), np.float32),
+                    "bias": np.zeros((4,), np.float32),
+                    "mean": np.zeros((4,), np.float32),
+                    "var": np.ones((4,), np.float32),
+                }
+            }
+        },
+    }
+    out = load_pretrained_backbone(variables, path)
+    assert _wide_leaves(out) == []
+    np.testing.assert_array_equal(
+        out["params"]["backbone"]["conv1"]["kernel"], 1.0
+    )
