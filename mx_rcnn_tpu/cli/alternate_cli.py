@@ -66,6 +66,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "re-seeds the rcnn1 phase, as the reference does",
     )
     p.add_argument(
+        "--strict-resume", action="store_true",
+        help="fail (instead of warn) when a phase's config drifts from "
+        "the workdir's recorded config.json",
+    )
+    p.add_argument(
         "--external-proposals", action="store_true",
         help="reference-faithful schedule: rcnn phases train on the pkl "
         "dumped by the preceding rpn phase (Fast R-CNN mode, RPN out of "
@@ -92,6 +97,7 @@ def alternate_train(
     num_phases: int = 4,
     pretrained=None,
     external_proposals: bool = False,
+    strict_resume: bool = False,
 ):
     """Run the 6-step schedule; returns the final combined TrainState.
 
@@ -162,6 +168,7 @@ def alternate_train(
             # re-seeded rcnn1 of the reference-faithful schedule.
             pretrained=pretrained if (state is None or reseed) else None,
             proposals_path=proposals_path,
+            strict_resume=strict_resume,
         )
     # combine_model parity: nothing to merge — one pytree holds RPN + RCNN.
     # Save the combined result under the BASE config name so eval/demo find
@@ -196,6 +203,7 @@ def main(argv=None):
         dump_proposals_pkl=not args.no_proposal_dump,
         pretrained=args.pretrained,
         external_proposals=args.external_proposals,
+        strict_resume=args.strict_resume,
     )
     from mx_rcnn_tpu.cli.eval_cli import run_eval
 
@@ -206,10 +214,24 @@ def cli(argv=None) -> int:
     """Console-script entry point ([project.scripts]).  ``main`` returns
     its result dict for programmatic callers; returning that from a
     console script would make ``sys.exit`` treat the truthy dict as a
-    FAILURE exit status, so discard it and return 0 explicitly."""
-    main(argv)
+    FAILURE exit status, so discard it and return 0 explicitly.
+
+    A preemption mid-phase exits with RESUMABLE_EXIT_CODE after the
+    emergency checkpoint lands (see train_cli.cli)."""
+    from mx_rcnn_tpu.train.preemption import RESUMABLE_EXIT_CODE, Preempted
+
+    try:
+        main(argv)
+    except Preempted as p:
+        log.warning(
+            "preempted at step %d (checkpoint: %s); exiting %d",
+            p.step, p.ckpt_dir, RESUMABLE_EXIT_CODE,
+        )
+        return RESUMABLE_EXIT_CODE
     return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(cli())

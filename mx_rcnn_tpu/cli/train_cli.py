@@ -21,6 +21,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     add_config_args(p)
     p.add_argument("--resume", action="store_true", help="resume from workdir ckpt")
     p.add_argument(
+        "--strict-resume", action="store_true",
+        help="fail (instead of warn) when the resumed config drifts from "
+        "the workdir's recorded config.json",
+    )
+    p.add_argument(
         "--steps", type=int, default=None, help="override schedule total_steps"
     )
     p.add_argument(
@@ -74,6 +79,7 @@ def main(argv=None) -> dict:
         profile_dir=args.profile,
         pretrained=args.pretrained,
         proposals_path=args.proposals,
+        strict_resume=args.strict_resume,
     )
     metrics: dict = {"final_step": int(jax.device_get(state.step))}
     if not args.no_eval:
@@ -87,10 +93,25 @@ def cli(argv=None) -> int:
     """Console-script entry point ([project.scripts]).  ``main`` returns
     its result dict for programmatic callers; returning that from a
     console script would make ``sys.exit`` treat the truthy dict as a
-    FAILURE exit status, so discard it and return 0 explicitly."""
-    main(argv)
+    FAILURE exit status, so discard it and return 0 explicitly.
+
+    A preemption (SIGTERM/SIGINT mid-run) exits with the distinct
+    RESUMABLE_EXIT_CODE after the emergency checkpoint lands, so
+    schedulers can tell "requeue with --resume" from a real failure."""
+    from mx_rcnn_tpu.train.preemption import RESUMABLE_EXIT_CODE, Preempted
+
+    try:
+        main(argv)
+    except Preempted as p:
+        log.warning(
+            "preempted at step %d (checkpoint: %s); exiting %d — requeue "
+            "with --resume", p.step, p.ckpt_dir, RESUMABLE_EXIT_CODE,
+        )
+        return RESUMABLE_EXIT_CODE
     return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(cli())

@@ -294,6 +294,13 @@ class TrainConfig:
     checkpoint_every: int = 5000
     log_every: int = 20
     seed: int = 0
+    # NaN guardian (train/guardian.py): rollback-and-skip retries allowed
+    # before a non-finite metric becomes a hard TrainingDiverged error.
+    # 0 = detect-and-raise immediately (no rollback).
+    guardian_rollbacks: int = 2
+    # Loss-spike early warning: interval mean this many sigma above the
+    # trailing-window mean logs loudly (no rollback — just visibility).
+    guardian_spike_z: float = 8.0
 
 
 @dataclass(frozen=True)

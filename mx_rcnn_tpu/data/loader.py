@@ -14,8 +14,12 @@ compute (the reference relied on MXNet's threaded DataIter for the same).
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
@@ -37,6 +41,14 @@ try:
     import cv2
 except Exception:  # pragma: no cover
     cv2 = None
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+# tools/chaos.py fault hook: comma-separated GLOBAL batch indices whose
+# images are replaced with NaN before yielding (training only) — exercises
+# the guardian's detect/rollback path end-to-end without touching the
+# model or the schedule.
+CHAOS_NAN_ENV = "MX_RCNN_CHAOS_NAN_STEPS"
 
 # Box-relative resolution at which gt instance masks are rasterized on host;
 # the device crops these to the mask head's target size per sampled roi.
@@ -136,6 +148,8 @@ class DetectionLoader:
         proposals: Optional[dict] = None,
         num_proposals: int = 1000,
         run_length: int = 1,
+        quarantine_path: Optional[str] = None,
+        io_retries: int = 2,
     ) -> None:
         """``proposals``: image_id → {"boxes": (n, 4) ORIGINAL-image coords,
         "scores": (n,)} (the ``test.py --proposals`` pkl format) — shipped
@@ -204,6 +218,27 @@ class DetectionLoader:
                 )
         if not self.roidb:
             raise ValueError("empty roidb shard")
+        # I/O hardening (docs/robustness.md): a record whose pixels cannot
+        # be loaded after bounded retries is quarantined — recorded to
+        # ``quarantine_path`` and substituted with a black canvas whose gt
+        # slots are all invalid — instead of killing the run.  The batch
+        # SCHEDULE never depends on load success (it is derived from the
+        # roidb alone), so substitution is schedule-deterministic and
+        # multi-host ranks stay in lockstep: shapes and collectives are
+        # unchanged, only local pixel content differs.
+        self.quarantine_path = quarantine_path
+        self.io_retries = max(int(io_retries), 0)
+        self._quarantine_lock = threading.Lock()
+        self._quarantined: set[str] = set()
+        nan_env = os.environ.get(CHAOS_NAN_ENV, "") if train else ""
+        self._nan_steps = frozenset(
+            int(tok) for tok in nan_env.split(",") if tok.strip()
+        )
+        if self._nan_steps:
+            log.warning(
+                "chaos: NaN injection armed for global batch indices %s",
+                sorted(self._nan_steps),
+            )
 
     # -- ordering ----------------------------------------------------------
 
@@ -254,8 +289,45 @@ class DetectionLoader:
 
     # -- single image ------------------------------------------------------
 
+    def _quarantine(self, rec: RoiRecord, error: BaseException) -> None:
+        with self._quarantine_lock:
+            if rec.image_id in self._quarantined:
+                return  # already recorded; don't re-log every epoch
+            self._quarantined.add(rec.image_id)
+            log.error(
+                "quarantining image %r (%s: %s) after %d retries; "
+                "substituting a blank example",
+                rec.image_id, type(error).__name__, error, self.io_retries,
+            )
+            if self.quarantine_path is None:
+                return
+            os.makedirs(
+                os.path.dirname(self.quarantine_path) or ".", exist_ok=True
+            )
+            with open(self.quarantine_path, "a") as f:
+                f.write(json.dumps({
+                    "image_id": rec.image_id,
+                    "path": rec.image_path,
+                    "error": f"{type(error).__name__}: {error}",
+                    "retries": self.io_retries,
+                }) + "\n")
+
+    def _load_image(self, rec: RoiRecord) -> tuple[np.ndarray, bool]:
+        """``(pixels, ok)`` — bounded retry on I/O errors, then a black
+        uint8 canvas with ``ok=False`` (the caller invalidates the gt)."""
+        err: Optional[BaseException] = None
+        for attempt in range(self.io_retries + 1):
+            try:
+                return load_image(rec), True
+            except (OSError, ValueError) as e:
+                err = e
+                if attempt < self.io_retries:
+                    time.sleep(0.1 * (2 ** attempt))
+        self._quarantine(rec, err)
+        return np.zeros((rec.height, rec.width, 3), np.uint8), False
+
     def _example(self, rec: RoiRecord, flip: bool):
-        img = load_image(rec)
+        img, img_ok = self._load_image(rec)
         boxes = rec.boxes
         if flip:
             img, boxes = hflip(img, boxes, rec.width)
@@ -311,6 +383,12 @@ class DetectionLoader:
         # difficult — never fg, shields bg sampling), or padding (neither).
         gt_valid[:n] = ~ign[:n]
         gt_ignore[:n] = ign[:n]
+        if not img_ok:
+            # Quarantined image: blank pixels with no gt — contributes
+            # nothing to the loss but keeps every shape (and therefore
+            # every collective) identical across hosts.
+            gt_valid[:] = False
+            gt_ignore[:] = False
         masks = None
         if self.with_masks:
             masks = np.zeros((g, GT_MASK_SIZE, GT_MASK_SIZE), np.float32)
@@ -410,7 +488,29 @@ class DetectionLoader:
         lo = self._rank * local
         return recs[lo:lo + local], flips[lo:lo + local]
 
+    def _poison(self, batch: Batch, idx: int) -> Batch:
+        """Chaos hook (CHAOS_NAN_ENV): replace the batch's pixels with NaN."""
+        if not np.issubdtype(batch.images.dtype, np.floating):
+            raise ValueError(
+                f"{CHAOS_NAN_ENV} needs float images (synthetic/normalized "
+                f"paths); batch {idx} is {batch.images.dtype}"
+            )
+        log.warning("chaos: injecting NaN images at global batch %d", idx)
+        return batch._replace(
+            images=np.full_like(batch.images, np.nan)
+        )
+
     def _train_batches(self, skip_batches: int = 0) -> Iterator[Batch]:
+        it = self._raw_train_batches(skip_batches)
+        if not self._nan_steps:
+            yield from it
+            return
+        # Both paths below yield batches in global-schedule order, so the
+        # yielded position IS the global batch index.
+        for idx, batch in enumerate(it, start=skip_batches):
+            yield self._poison(batch, idx) if idx in self._nan_steps else batch
+
+    def _raw_train_batches(self, skip_batches: int = 0) -> Iterator[Batch]:
         specs = self._batch_specs()
         # Resume fast-forward: spec generation (shuffle order + flip draws)
         # is cheap; skipping specs instead of restarting keeps the resumed

@@ -86,10 +86,22 @@ def make_train_step(
             return total, metrics
 
         grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        with jax.named_scope("guardian"):
+            # On-device finiteness reduction (train/guardian.py): ONE 0/1
+            # scalar covering the gradient global norm (inf/NaN anywhere
+            # in the grad tree makes the norm non-finite) and every loss
+            # metric.  It rides the metric dict the loop already fetches
+            # once per log interval — no per-step host sync is added, so
+            # the hot loop stays transfer_guard-clean (tools/tpulint.py).
+            finite = jnp.isfinite(optax.global_norm(grads))
+            for key in sorted(metrics):
+                finite &= jnp.all(jnp.isfinite(metrics[key]))
+            nonfinite = 1.0 - finite.astype(jnp.float32)
         with jax.named_scope("optimizer"):
             new_state = state.apply_gradients(grads, tx)
+        metrics = dict(metrics, nonfinite=nonfinite)
         if schedule is not None:
-            metrics = dict(metrics, lr=schedule(state.step))
+            metrics["lr"] = schedule(state.step)
         return new_state, metrics
 
     def multi_step(state: TrainState, batches: Batch):

@@ -7,24 +7,52 @@ Replaces the reference's L1 runtime (SURVEY.md §3.7): ``rcnn/core/module.py``
 executor-rebinding module and per-epoch NDArray dict dumps, training state is
 one pytree (params + optimizer state + step + rng) updated by a pure jitted
 step and checkpointed atomically with orbax.
+
+Fault tolerance (docs/robustness.md): preemption-safe checkpoints
+(``preemption``), NaN detection + bounded checkpoint rollback
+(``guardian``), and retry/fallback-hardened checkpoint I/O
+(``checkpoint``); ``tools/chaos.py`` drives the whole surface against a
+real training subprocess.
 """
 
 from mx_rcnn_tpu.train.checkpoint import (
+    all_steps,
+    delete_steps_after,
+    finite_state,
+    flush_checkpoints,
     latest_step,
     restore_checkpoint,
+    restore_raw,
     save_checkpoint,
 )
+from mx_rcnn_tpu.train.guardian import Guardian, Rollback, TrainingDiverged
 from mx_rcnn_tpu.train.metrics import Speedometer
 from mx_rcnn_tpu.train.optim import make_optimizer, make_schedule
+from mx_rcnn_tpu.train.preemption import (
+    RESUMABLE_EXIT_CODE,
+    Preempted,
+    PreemptionGuard,
+)
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
 
 __all__ = [
+    "Guardian",
+    "Preempted",
+    "PreemptionGuard",
+    "RESUMABLE_EXIT_CODE",
+    "Rollback",
     "Speedometer",
     "TrainState",
+    "TrainingDiverged",
+    "all_steps",
     "create_train_state",
+    "delete_steps_after",
+    "finite_state",
+    "flush_checkpoints",
     "latest_step",
     "make_optimizer",
     "make_schedule",
     "restore_checkpoint",
+    "restore_raw",
     "save_checkpoint",
 ]

@@ -11,52 +11,177 @@ The reference folds BBOX_MEANS/STDS into the bbox_pred weights at save time
 so inference needs no un-normalization; our decode applies
 ``cfg.rcnn.bbox_weights`` in-graph instead, so checkpoints are always in
 training parameterization and no folding step exists to get wrong.
+
+Fault-tolerance hardening (docs/robustness.md):
+
+* ONE cached ``CheckpointManager`` per run directory.  The old
+  open/close-per-call pattern re-scanned the directory on every save and —
+  worse — ``close()`` on an async manager could drop an in-flight save on
+  the floor.  Cached managers live for the process; an ``atexit`` hook
+  drains pending async saves before interpreter teardown.
+* ``save_checkpoint`` retries with exponential backoff on I/O errors
+  (surfaced either by the save call or by a previous async save).
+* ``restore_checkpoint`` walks back to earlier steps when the latest
+  checkpoint is truncated/corrupt or fails the caller's ``validate``
+  predicate, instead of crashing the run on a partial write.
 """
 
 from __future__ import annotations
 
+import atexit
+import logging
 import os
-from typing import Optional
+import threading
+import time
+from typing import Callable, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from mx_rcnn_tpu.train.state import TrainState
 
+log = logging.getLogger("mx_rcnn_tpu")
+
+_MANAGERS: dict[str, ocp.CheckpointManager] = {}
+_MANAGERS_LOCK = threading.Lock()
+
 
 def _manager(ckpt_dir: str, max_to_keep: int = 5) -> ocp.CheckpointManager:
-    return ocp.CheckpointManager(
-        os.path.abspath(ckpt_dir),
-        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
-    )
+    """The process-wide cached manager for ``ckpt_dir``.
+
+    One manager per run directory for the life of the process: repeated
+    saves reuse its state instead of re-scanning the directory, and async
+    saves are only ever awaited (``wait_until_finished``), never dropped
+    by an early ``close()``.
+    """
+    path = os.path.abspath(ckpt_dir)
+    with _MANAGERS_LOCK:
+        mgr = _MANAGERS.get(path)
+        if mgr is None:
+            mgr = ocp.CheckpointManager(
+                path,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True
+                ),
+            )
+            _MANAGERS[path] = mgr
+    return mgr
 
 
-def save_checkpoint(ckpt_dir: str, state: TrainState, *, wait: bool = False) -> None:
+def flush_checkpoints(ckpt_dir: Optional[str] = None) -> None:
+    """Block until pending async saves land (all cached dirs by default)."""
+    with _MANAGERS_LOCK:
+        mgrs = (
+            list(_MANAGERS.values())
+            if ckpt_dir is None
+            else [m for p, m in _MANAGERS.items()
+                  if p == os.path.abspath(ckpt_dir)]
+        )
+    for mgr in mgrs:
+        try:
+            mgr.wait_until_finished()
+        except Exception:  # pragma: no cover - teardown best-effort
+            log.exception("draining async checkpoint save failed")
+
+
+def close_managers() -> None:
+    """Drain and close every cached manager (atexit; also used by tests)."""
+    with _MANAGERS_LOCK:
+        mgrs = list(_MANAGERS.items())
+        _MANAGERS.clear()
+    for path, mgr in mgrs:
+        try:
+            mgr.wait_until_finished()
+            mgr.close()
+        except Exception:  # pragma: no cover - teardown best-effort
+            log.exception("closing checkpoint manager for %s failed", path)
+
+
+atexit.register(close_managers)
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state: TrainState,
+    *,
+    wait: bool = False,
+    retries: int = 3,
+    backoff: float = 0.5,
+) -> None:
+    """Save ``state`` at its step; retry with exponential backoff on I/O
+    errors.  A step that is already on disk is left alone (the emergency
+    preemption save can race the cadence save at the same boundary)."""
     mgr = _manager(ckpt_dir)
-    mgr.save(int(state.step), args=ocp.args.StandardSave(state))
+    step = int(state.step)
+    last_err: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            if step in set(mgr.all_steps()):
+                break
+            mgr.save(step, args=ocp.args.StandardSave(state))
+            break
+        except Exception as e:
+            last_err = e
+            if attempt == retries:
+                raise
+            delay = backoff * (2 ** attempt)
+            log.warning(
+                "checkpoint save at step %d failed (%s: %s); retry %d/%d "
+                "in %.1fs", step, type(e).__name__, e, attempt + 1, retries,
+                delay,
+            )
+            time.sleep(delay)
     if wait:
-        mgr.wait_until_finished()
-    mgr.close()
+        try:
+            mgr.wait_until_finished()
+        except Exception:
+            if last_err is not None:
+                raise
+            raise
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
-    mgr = _manager(ckpt_dir)
-    step = mgr.latest_step()
-    mgr.close()
-    return step
+    return _manager(ckpt_dir).latest_step()
 
 
-def restore_checkpoint(
-    ckpt_dir: str, target: TrainState, step: Optional[int] = None
-) -> TrainState:
-    """Restore into the structure of ``target`` (shapes/dtypes from it)."""
+def all_steps(ckpt_dir: str) -> list[int]:
+    """Ascending step numbers present under ``ckpt_dir`` ([] if none)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(_manager(ckpt_dir).all_steps())
+
+
+def delete_steps_after(ckpt_dir: str, step: int) -> list[int]:
+    """Delete checkpoints newer than ``step`` (guardian rollback: a
+    poisoned step number must not shadow its retrained replacement —
+    orbax silently no-ops a save whose step already exists)."""
     mgr = _manager(ckpt_dir)
-    if step is None:
-        step = mgr.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    doomed = sorted(s for s in mgr.all_steps() if s > step)
+    for s in doomed:
+        try:
+            mgr.delete(s)
+        except Exception as e:  # pragma: no cover - best-effort cleanup
+            log.warning("deleting stale checkpoint step %d failed: %s", s, e)
+    return doomed
+
+
+def finite_state(state) -> bool:
+    """True when every floating-point leaf of ``state`` is finite — the
+    default restore validation used by the guardian's rollback (a
+    checkpoint taken inside a NaN window must not be a rollback target)."""
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.all(
+            np.isfinite(arr)
+        ):
+            return False
+    return True
+
+
+def _abstract_target(target):
     def _abstract(x):
         if isinstance(x, jax.ShapeDtypeStruct):
             # Callers that build the target under jax.eval_shape (eval/demo
@@ -67,7 +192,87 @@ def restore_checkpoint(
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
         return ocp.utils.to_shape_dtype_struct(x)
 
-    abstract = jax.tree_util.tree_map(_abstract, target)
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mgr.close()
-    return restored
+    return jax.tree_util.tree_map(_abstract, target)
+
+
+def restore_checkpoint(
+    ckpt_dir: str,
+    target: TrainState,
+    step: Optional[int] = None,
+    *,
+    max_step: Optional[int] = None,
+    validate: Optional[Callable[[TrainState], bool]] = None,
+) -> TrainState:
+    """Restore into the structure of ``target`` (shapes/dtypes from it).
+
+    ``step=None`` restores the newest checkpoint ``<= max_step`` (if
+    given), falling back to progressively older steps when a candidate is
+    truncated/corrupt on disk or fails ``validate`` — a partial write of
+    the latest checkpoint must cost one checkpoint interval, not the run.
+    An explicit ``step`` disables the fallback walk (the caller asked for
+    exactly that checkpoint).
+    """
+    mgr = _manager(ckpt_dir)
+    abstract = _abstract_target(target)
+    if step is not None:
+        candidates = [step]
+    else:
+        candidates = sorted(mgr.all_steps(), reverse=True)
+        if max_step is not None:
+            candidates = [s for s in candidates if s <= max_step]
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    last_err: Optional[BaseException] = None
+    for i, s in enumerate(candidates):
+        try:
+            restored = mgr.restore(s, args=ocp.args.StandardRestore(abstract))
+            if validate is not None and not validate(restored):
+                raise ValueError(
+                    f"checkpoint step {s} failed restore validation"
+                )
+            if i:
+                log.warning(
+                    "checkpoint step %d unusable (%s); fell back to step %d",
+                    candidates[0], last_err, s,
+                )
+            return restored
+        except Exception as e:
+            if step is not None:
+                raise
+            last_err = e
+            log.warning(
+                "restoring checkpoint step %d from %s failed (%s: %s); "
+                "trying an earlier step", s, ckpt_dir, type(e).__name__, e,
+            )
+    raise RuntimeError(
+        f"every checkpoint under {ckpt_dir} failed to restore "
+        f"(steps tried: {candidates}); last error: {last_err!r}"
+    )
+
+
+def restore_raw(ckpt_dir: str, step: Optional[int] = None):
+    """Targetless restore of the saved pytree (tools/chaos.py's bitwise
+    comparisons — no model build needed).  Same fallback walk as
+    :func:`restore_checkpoint` when ``step`` is None."""
+    mgr = _manager(ckpt_dir)
+    candidates = (
+        [step] if step is not None else sorted(mgr.all_steps(), reverse=True)
+    )
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    last_err: Optional[BaseException] = None
+    for s in candidates:
+        try:
+            return mgr.restore(s, args=ocp.args.StandardRestore())
+        except Exception as e:
+            if step is not None:
+                raise
+            last_err = e
+            log.warning(
+                "raw restore of step %d from %s failed (%s); trying an "
+                "earlier step", s, ckpt_dir, type(e).__name__,
+            )
+    raise RuntimeError(
+        f"every checkpoint under {ckpt_dir} failed raw restore; "
+        f"last error: {last_err!r}"
+    )

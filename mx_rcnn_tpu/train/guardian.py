@@ -1,0 +1,142 @@
+"""NaN guardian: divergence detection + bounded checkpoint rollback.
+
+bf16 runs spike to NaN — a bad batch, an lr boundary, an overflowing loss
+term — and without a watchdog the first non-finite gradient silently
+poisons the params; every later step (and checkpoint!) is garbage.  The
+guardian closes that hole with zero steady-state cost:
+
+* **Detection** rides the existing one-``device_get``-per-interval metrics
+  drain.  The train step computes a single on-device finiteness reduction
+  (``metrics["nonfinite"]`` in ``parallel/step.py``: gradient global norm
+  + every loss metric, reduced to one 0/1 scalar) that travels with the
+  metric dict the loop already fetches — no extra transfers, and the hot
+  loop stays ``transfer_guard('disallow')``-clean (tools/tpulint.py).
+* **Rollback**: on detection, the loop restores the newest checkpoint at
+  or below the last *validated-finite* boundary (restore re-validates leaf
+  finiteness — a checkpoint taken inside the bad window is never a
+  target), advances the data schedule past the offending window, and
+  retries.  Retries are bounded; exhaustion raises
+  :class:`TrainingDiverged` — a hard, loud stop, never a silent NaN run.
+* **Loss-spike early warning**: a z-score of the interval's mean loss
+  against a trailing window logs loudly below the hard threshold, so
+  divergence-in-progress is visible in the logs before it becomes NaN.
+
+Multi-host: the metrics are computed by the sharded step over the global
+batch, so every process fetches identical values and takes the rollback
+branch at the same boundary — lockstep is preserved by construction, the
+same argument the loader's global batch schedule makes.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+
+class TrainingDiverged(RuntimeError):
+    """Non-finite training metrics persisted past the rollback budget."""
+
+
+@dataclass(frozen=True)
+class Rollback:
+    """The guardian's verdict at a poisoned metrics drain.
+
+    ``detect_step``: the step boundary whose interval contained the first
+    non-finite value — the loop must restore a checkpoint at or below the
+    last clean boundary and skip the data window ending here.
+    """
+
+    detect_step: int
+    reason: str
+    attempt: int
+
+
+class Guardian:
+    """Per-run divergence watchdog (one instance per ``train()`` call).
+
+    ``observe`` is called at every metrics drain with the interval means
+    and the per-step host values (both already on host — the loop fetched
+    them in its single interval ``device_get``).  Returns a
+    :class:`Rollback` when the interval is poisoned, ``None`` when clean.
+    """
+
+    def __init__(
+        self,
+        max_rollbacks: int = 2,
+        spike_zscore: float = 8.0,
+        spike_window: int = 64,
+    ) -> None:
+        self.max_rollbacks = max_rollbacks
+        self.spike_zscore = spike_zscore
+        self.rollbacks = 0
+        self._losses: collections.deque[float] = collections.deque(
+            maxlen=spike_window
+        )
+
+    # -- detection ---------------------------------------------------------
+
+    @staticmethod
+    def _poisoned(means: dict, per_step: list[dict]) -> Optional[str]:
+        # The on-device reduction is authoritative (it also covers the
+        # gradient global norm, which the logged metrics don't); the
+        # per-value sweep additionally catches non-finite values if the
+        # step fn ever ships metrics without the reduction.
+        for d in per_step:
+            if d.get("nonfinite", 0.0) > 0.0:
+                return "on-device finiteness reduction tripped"
+        if means.get("nonfinite", 0.0) > 0.0:
+            # steps_per_call>1 folds K steps into one mean — any positive
+            # mean still means at least one poisoned step.
+            return "on-device finiteness reduction tripped (interval mean)"
+        for key, v in sorted(means.items()):
+            if not math.isfinite(v):
+                return f"interval mean of {key!r} is {v!r}"
+        return None
+
+    def observe(
+        self, step: int, means: dict, per_step: list[dict]
+    ) -> Optional[Rollback]:
+        reason = self._poisoned(means, per_step)
+        if reason is not None:
+            self.rollbacks += 1
+            if self.rollbacks > self.max_rollbacks:
+                raise TrainingDiverged(
+                    f"non-finite training metrics at step {step} ({reason}) "
+                    f"after {self.max_rollbacks} rollback retr"
+                    f"{'y' if self.max_rollbacks == 1 else 'ies'} — "
+                    "the divergence is not data-local; lower the lr or "
+                    "inspect the model"
+                )
+            log.error(
+                "guardian: %s at step %d — rolling back to the last good "
+                "checkpoint and skipping the offending data window "
+                "(attempt %d/%d)", reason, step, self.rollbacks,
+                self.max_rollbacks,
+            )
+            return Rollback(step, reason, self.rollbacks)
+        self._note_loss(step, means)
+        return None
+
+    # -- loss-spike early warning -----------------------------------------
+
+    def _note_loss(self, step: int, means: dict) -> None:
+        loss = means.get("loss")
+        if loss is None:
+            return
+        n = len(self._losses)
+        if n >= 8:
+            mean = sum(self._losses) / n
+            var = sum((x - mean) ** 2 for x in self._losses) / n
+            std = math.sqrt(var)
+            if std > 0.0 and (loss - mean) / std > self.spike_zscore:
+                log.warning(
+                    "guardian: loss spike at step %d — %.4f is %.1f sigma "
+                    "above the trailing-window mean %.4f (watching for "
+                    "divergence)", step, loss, (loss - mean) / std, mean,
+                )
+        self._losses.append(float(loss))

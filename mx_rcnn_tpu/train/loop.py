@@ -29,12 +29,21 @@ from mx_rcnn_tpu.parallel import (
     replicated,
 )
 from mx_rcnn_tpu.parallel.mesh import MODEL_AXIS
-from mx_rcnn_tpu.train.checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from mx_rcnn_tpu.train.checkpoint import (
+    delete_steps_after,
+    finite_state,
+    flush_checkpoints,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.train.guardian import Guardian
 from mx_rcnn_tpu.train.metrics import (
     ScalarWriter,
     Speedometer,
-    host_mean_metrics,
+    host_interval_metrics,
 )
+from mx_rcnn_tpu.train.preemption import Preempted, PreemptionGuard
 from mx_rcnn_tpu.train.optim import frozen_mask, make_optimizer
 from mx_rcnn_tpu.train.state import TrainState, create_train_state
 from mx_rcnn_tpu.utils import ProfileWindow
@@ -172,13 +181,20 @@ def _flat_config(d: dict, prefix: str = "") -> dict:
     return out
 
 
-def _warn_config_drift(cfg: Config, config_json_path: str) -> None:
+class ConfigDriftError(RuntimeError):
+    """--strict-resume: the resumed config differs from the run-start one."""
+
+
+def _warn_config_drift(
+    cfg: Config, config_json_path: str, strict: bool = False
+) -> None:
     """Resuming under a different config than the run was started with
     silently changes the training trajectory — the global batch / lr scale
     shift the schedule, and the loader's fast-forward replays a different
     data order.  The run directory's config.json records the original; log
-    every differing field loudly instead of failing (intentional overrides
-    on resume are legitimate)."""
+    every differing field loudly (intentional overrides on resume are
+    legitimate), or — ``strict`` (the ``--strict-resume`` flag, production
+    runs) — fail hard with the full drift list."""
     import dataclasses as _dc
     import json as _json
     import os as _os
@@ -195,14 +211,21 @@ def _warn_config_drift(cfg: Config, config_json_path: str) -> None:
     def norm(v):
         return list(v) if isinstance(v, tuple) else v
 
+    drift: list[str] = []
     for key in sorted(set(saved) | set(current)):
         a, b = saved.get(key), norm(current.get(key))
         if a != b:
+            drift.append(f"{key}: {a!r} -> {b!r}")
             log.warning(
                 "resume config drift: %s was %r at run start, now %r — "
                 "schedule/data continuity is NOT guaranteed across this "
                 "change", key, a, b,
             )
+    if strict and drift:
+        raise ConfigDriftError(
+            "--strict-resume: config drifted from the run-start "
+            f"config.json ({config_json_path}):\n  " + "\n  ".join(drift)
+        )
 
 
 def _stacked_batches(it, k: int):
@@ -234,13 +257,21 @@ def train(
     profile_steps: tuple[int, int] = (10, 15),
     pretrained: Optional[str] = None,
     proposals_path: Optional[str] = None,
+    strict_resume: bool = False,
 ) -> TrainState:
     """Train for ``total_steps`` (default: cfg schedule length); returns the
     final state (host-fetchable).  Pass ``state`` to continue from an earlier
     phase (alternate training), ``resume`` to restore from workdir;
     ``profile_dir`` traces steps ``profile_steps`` into it (jax.profiler);
     ``proposals_path`` trains the box head on an external proposal pkl
-    (Fast R-CNN mode — reference ``rcnn/tools/train_rcnn.py``)."""
+    (Fast R-CNN mode — reference ``rcnn/tools/train_rcnn.py``);
+    ``strict_resume`` escalates resume config drift to a hard error.
+
+    Fault tolerance (docs/robustness.md): SIGTERM/SIGINT drain the
+    in-flight step, write a synchronous emergency checkpoint and raise
+    :class:`~mx_rcnn_tpu.train.preemption.Preempted` (the CLIs map it to
+    the resumable exit code); non-finite metrics trigger the guardian's
+    bounded rollback-and-skip, then :class:`TrainingDiverged`."""
     if mesh is None and jax.device_count() > 1:
         mesh = make_mesh(model_parallel=cfg.train.spatial_partition)
     model, tx, fresh_state, step_fn, global_batch = build_all(
@@ -266,9 +297,15 @@ def train(
     )
     ckpt_dir = f"{workdir or cfg.workdir}/{cfg.name}/ckpt"
     if resume and latest_step(ckpt_dir) is not None:
-        state = restore_checkpoint(ckpt_dir, state)
+        # Restore validates finiteness and falls back past a truncated or
+        # corrupt latest checkpoint (a kill mid-write costs one checkpoint
+        # interval, not the run).
+        state = restore_checkpoint(ckpt_dir, state, validate=finite_state)
         log.info("resumed from %s at step %d", ckpt_dir, int(state.step))
-        _warn_config_drift(cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json")
+        _warn_config_drift(
+            cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json",
+            strict=strict_resume,
+        )
 
     if loader is None:
         from mx_rcnn_tpu.data import load_proposals
@@ -289,6 +326,11 @@ def train(
             # Stacked steps_per_call calls scan K batches in one device
             # program — the loader must emit K same-canvas batches per run.
             run_length=max(cfg.train.steps_per_call, 1),
+            # Unreadable images are retried, then quarantined to this jsonl
+            # and deterministically substituted instead of killing the run.
+            quarantine_path=(
+                f"{workdir}/{cfg.name}/quarantine.jsonl" if workdir else None
+            ),
         )
     if mesh is not None:
         state = jax.device_put(state, replicated(mesh))
@@ -297,8 +339,12 @@ def train(
     start = int(state.step)
     writer = None
     if workdir and jax.process_index() == 0:
+        # resume_step truncates rows ahead of the restored step — a crash
+        # between checkpoint and metrics flush (or a guardian rollback of a
+        # previous run) must not leave duplicate/contradictory rows.
         writer = ScalarWriter(
-            f"{workdir}/{cfg.name}/metrics.jsonl", resume=start > 0
+            f"{workdir}/{cfg.name}/metrics.jsonl", resume=start > 0,
+            resume_step=start,
         )
         # Reproducibility: the exact resolved config next to its artifacts
         # (the reference leaves hyperparameters scattered across argparse
@@ -322,13 +368,25 @@ def train(
             f"total steps {steps - start} not divisible by "
             f"train.steps_per_call={k}"
         )
-    host_it = loader.iter_from(skip_batches=start)
-    if k > 1:
-        host_it = _stacked_batches(host_it, k)
-    it = device_prefetch(
-        host_it, mesh, depth=2,
-        spatial=cfg.train.spatial_partition > 1, stacked=k > 1,
-    )
+    spatial = cfg.train.spatial_partition > 1
+
+    def data_iter(from_step: int, extra_skip: int):
+        # Rebuilt after a guardian rollback: ``extra_skip`` batches of the
+        # global schedule are dropped so the retried steps see FRESH data
+        # (the offending window is skipped, not replayed).
+        host_it = loader.iter_from(skip_batches=from_step + extra_skip)
+        if k > 1:
+            host_it = _stacked_batches(host_it, k)
+        return device_prefetch(
+            host_it, mesh, depth=2, spatial=spatial, stacked=k > 1,
+        )
+
+    # Rollback safety net: make sure SOME checkpoint exists before the
+    # first cadence save — a NaN (or preemption) inside the first
+    # checkpoint interval then rolls back to/resumes from the start state
+    # instead of aborting the run.
+    if workdir and latest_step(ckpt_dir) is None:
+        save_checkpoint(ckpt_dir, jax.device_get(state))
     # Quantize the profile window to the loop stride so it still opens
     # when i advances k at a time.  Round UP: the default (10, 15) window
     # exists to skip the compile step, so the start must never be pulled
@@ -342,32 +400,101 @@ def train(
     # the program (trace-time constant transfers are expected then), every
     # step runs under transfer_guard — any implicit host sync that creeps
     # into the loop raises instead of silently serializing the pipeline.
-    # Metrics stay on device in `pending`; ONE device_get per log interval.
+    # Metrics stay on device in `pending`; ONE device_get per drain (log
+    # points, checkpoint boundaries, preemption) — the guardian's
+    # finiteness verdict rides that same transfer (train/guardian.py).
     guard_mode = os.environ.get("MX_RCNN_TRANSFER_GUARD", "disallow")
+    # Rollback needs checkpoints; without a workdir the guardian can only
+    # detect-and-raise.
+    guardian = Guardian(
+        max_rollbacks=cfg.train.guardian_rollbacks if workdir else 0,
+        spike_zscore=cfg.train.guardian_spike_z,
+    )
     pending: list[dict] = []
-    for i in range(start, steps, k):
-        profiler.step(i, sync=state.params)
-        guard = (
-            jax.transfer_guard(guard_mode)
-            if i != start and guard_mode != "off"
-            else contextlib.nullcontext()
-        )
-        with guard:
-            batch = next(it)
-            state, metrics = step_fn(state, batch)
-        pending.append(metrics)
-        done = i + k
-        if done % cfg.train.log_every < k or i == start:
-            host_metrics = host_mean_metrics(pending)
-            pending.clear()
-            speedo(done, host_metrics)
-            if writer:
-                writer.write(done, host_metrics)
-        if workdir and done % cfg.train.checkpoint_every < k:
-            save_checkpoint(ckpt_dir, jax.device_get(state))
+    it = data_iter(start, 0)
+    data_skip = 0      # batches the guardian skipped ahead of the schedule
+    last_good = start  # newest boundary whose drained metrics were finite
+    i = start
+    first_call = True
+    with PreemptionGuard() as preempt:
+        while i < steps:
+            profiler.step(i, sync=state.params)
+            guard = (
+                jax.transfer_guard(guard_mode)
+                if not first_call and guard_mode != "off"
+                else contextlib.nullcontext()
+            )
+            first_call = False
+            with guard:
+                batch = next(it)
+                state, metrics = step_fn(state, batch)
+            pending.append(metrics)
+            done = i + k
+            at_log = done % cfg.train.log_every < k or i == start
+            at_ckpt = bool(workdir) and done % cfg.train.checkpoint_every < k
+            if at_log or at_ckpt or preempt.triggered:
+                # Checkpoint boundaries drain too: a checkpoint is only
+                # written after its whole interval validated finite, so
+                # every on-disk step is a sound rollback target.
+                means, per_step = host_interval_metrics(pending)
+                pending.clear()
+                rollback = guardian.observe(done, means, per_step)
+                if rollback is not None:
+                    target = jax.device_get(state)
+                    state = restore_checkpoint(
+                        ckpt_dir, target, max_step=last_good,
+                        validate=finite_state,
+                    )
+                    restored = int(state.step)
+                    # A poisoned checkpoint newer than the rollback target
+                    # must not shadow its retrained replacement (orbax
+                    # no-ops saves whose step already exists).
+                    delete_steps_after(ckpt_dir, restored)
+                    # Explicit placement: restored leaves can arrive as
+                    # host arrays, and the next step runs under
+                    # transfer_guard('disallow') — implicit transfer would
+                    # raise there.
+                    state = (
+                        jax.device_put(state, replicated(mesh))
+                        if mesh is not None
+                        else jax.device_put(state)
+                    )
+                    # The retried window consumes the batches AFTER the
+                    # offending one — skip forward, never replay poison.
+                    data_skip += done - restored
+                    it = data_iter(restored, data_skip)
+                    if writer:
+                        writer.truncate(restored)
+                    speedo = Speedometer(global_batch)
+                    log.warning(
+                        "guardian rollback: restored step %d, skipping %d "
+                        "batch(es) of the data schedule (total skipped: %d)",
+                        restored, done - restored, data_skip,
+                    )
+                    i = restored
+                    continue
+                last_good = done
+                means.pop("nonfinite", None)
+                if at_log:
+                    speedo(done, means)
+                    if writer:
+                        writer.write(done, means)
+                if at_ckpt:
+                    save_checkpoint(ckpt_dir, jax.device_get(state))
+            if preempt.triggered:
+                # Drain complete; persist synchronously and exit resumable.
+                if workdir:
+                    save_checkpoint(
+                        ckpt_dir, jax.device_get(state), wait=True
+                    )
+                if writer:
+                    writer.close()
+                raise Preempted(done, ckpt_dir if workdir else None)
+            i = done
     profiler.close(sync=state.params)
     if writer:
         writer.close()
     if workdir:
         save_checkpoint(ckpt_dir, jax.device_get(state), wait=True)
+        flush_checkpoints(ckpt_dir)
     return state

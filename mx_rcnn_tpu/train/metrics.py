@@ -55,20 +55,33 @@ def device_metrics_to_host(metrics: dict) -> dict[str, float]:
     return {k: float(np.asarray(v)) for k, v in flat.items()}
 
 
-def host_mean_metrics(pending: list[dict]) -> dict[str, float]:
-    """Mean metrics over a log interval, fetched in ONE device_get.
+def host_interval_metrics(
+    pending: list[dict],
+) -> tuple[dict[str, float], list[dict[str, float]]]:
+    """Interval means + per-step host values, fetched in ONE device_get.
 
     The train loop appends each call's (device-resident) metric dict to
-    ``pending`` and only calls this at log points — the hot path never
+    ``pending`` and only calls this at drain points — the hot path never
     blocks on a host transfer, and the logged figure is the interval mean
     rather than a single call's snapshot.  ``lr`` reports the interval's
-    last value (a schedule read, not a statistic)."""
+    last value (a schedule read, not a statistic).  The per-step list is
+    the guardian's detection input (train/guardian.py): the finiteness
+    verdict needs every step's value, and it comes out of the SAME
+    transfer as the means — detection adds no host syncs."""
     flat = jax.device_get(pending)
+    steps = [
+        {k: float(np.asarray(v)) for k, v in d.items()} for d in flat
+    ]
     out: dict[str, float] = {}
-    for k in flat[-1]:
-        vals = [float(np.asarray(d[k])) for d in flat if k in d]
+    for k in steps[-1]:
+        vals = [d[k] for d in steps if k in d]
         out[k] = vals[-1] if k == "lr" else sum(vals) / len(vals)
-    return out
+    return out, steps
+
+
+def host_mean_metrics(pending: list[dict]) -> dict[str, float]:
+    """Mean metrics over a log interval (see host_interval_metrics)."""
+    return host_interval_metrics(pending)[0]
 
 
 class ScalarWriter:
@@ -78,15 +91,66 @@ class ScalarWriter:
     artifacts are stdout lines): machine-readable training curves under the
     workdir, one ``{"step": ..., metric: value, ...}`` object per line.
     Plotting/TensorBoard ingestion stays external; the contract is the file.
+
+    Resume correctness: a crash between a checkpoint and the next metrics
+    flush — or a guardian rollback — leaves rows AHEAD of the restored
+    step.  Appending from the restored step would then produce duplicate
+    or contradictory rows, so ``resume_step`` (and the rollback-time
+    ``truncate``) first drops every row with ``step > restored_step``
+    (including a torn partial last line) via an atomic rewrite.
     """
 
-    def __init__(self, path: str, resume: bool = False) -> None:
+    def __init__(
+        self, path: str, resume: bool = False,
+        resume_step: Optional[int] = None,
+    ) -> None:
         import os
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        if resume and resume_step is not None:
+            self._rewrite_upto(resume_step)
         # Fresh runs truncate: appending a second from-step-0 curve onto an
         # old one would leave a non-monotonic file for ingestors.
         self._f = open(path, "a" if resume else "w", buffering=1)
+
+    def _rewrite_upto(self, max_step: int) -> None:
+        """Atomically drop rows with step > ``max_step`` (and torn lines)."""
+        import json
+        import os
+
+        if not os.path.exists(self._path):
+            return
+        kept: list[str] = []
+        dropped = 0
+        with open(self._path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                    step = int(row["step"])
+                except (ValueError, KeyError, TypeError):
+                    dropped += 1  # torn partial write from a crash
+                    continue
+                if step <= max_step:
+                    kept.append(line if line.endswith("\n") else line + "\n")
+                else:
+                    dropped += 1
+        if not dropped:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(kept)
+        os.replace(tmp, self._path)
+        log.info(
+            "metrics log truncated to step %d (%d stale row(s) dropped)",
+            max_step, dropped,
+        )
+
+    def truncate(self, max_step: int) -> None:
+        """Guardian rollback: reopen past rows <= ``max_step`` only."""
+        self._f.close()
+        self._rewrite_upto(max_step)
+        self._f = open(self._path, "a", buffering=1)
 
     def write(self, step: int, metrics: dict) -> None:
         import json

@@ -58,6 +58,7 @@ COMPONENT_PATTERNS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("proposals", ("proposals",)),
     ("sampling", ("sample_rois", "assign_anchors")),
     ("preprocess", ("prep_images",)),
+    ("guardian", ("guardian",)),
     ("optimizer", ("optimizer",)),
 )
 

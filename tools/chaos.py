@@ -1,0 +1,383 @@
+"""Chaos harness: fault-inject a real training subprocess, prove recovery.
+
+The robustness claims in docs/robustness.md are cheap to assert and easy
+to regress silently — so this harness drives the REAL CLI (`train_cli`)
+as a subprocess on hermetic CPU (tiny_synthetic preset) and injects the
+faults the runtime is supposed to survive:
+
+  baseline  uninterrupted run; its final checkpoint is the bitwise oracle
+            for every recovery scenario below.
+  sigkill   SIGKILL (no grace, mid-flight) once a mid-run checkpoint
+            lands; resume with --resume; final params must be
+            BIT-IDENTICAL to baseline's.
+  sigterm   SIGTERM mid-run; the child must drain the in-flight step,
+            write the emergency checkpoint and exit RESUMABLE_EXIT_CODE;
+            resume; bit-identical final params.
+  nan       arm the loader's NaN hook (MX_RCNN_CHAOS_NAN_STEPS) for one
+            batch; the guardian must roll back, skip the window and
+            finish with every logged metric finite.
+  truncate  SIGKILL mid-run, then truncate the newest checkpoint's files
+            (simulating a kill inside the write); the resumed child must
+            fall back to the previous step and STILL converge to
+            baseline's exact params.
+
+Bit-identity holds because recovery re-runs the same compiled program
+over the same data schedule from the same restored state — it is the
+strongest possible "nothing was lost, nothing was double-applied" check
+and it needs no tolerance tuning.
+
+Usage:
+  python tools/chaos.py [--scenario all|baseline|sigkill|sigterm|nan|truncate]
+                        [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
+
+Prints one JSON summary line on stdout; exits non-zero if any scenario
+fails.  (`--child` / `--compare` are internal subprocess entry modes.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = "tiny_synthetic"
+CKPT_EVERY = 3
+LOG_EVERY = 2
+
+
+def _hermetic_cpu() -> None:
+    """CPU-only jax in THIS interpreter (same guards as tests/conftest.py:
+    the image's sitecustomize registers a TPU-tunnel PJRT plugin whose
+    retries can block even cpu backend init)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, REPO_ROOT)
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    assert isinstance(_xb._backend_factories, dict)
+    for name in list(_xb._backend_factories):
+        if name not in ("cpu", "tpu"):
+            _xb._backend_factories.pop(name, None)
+    jax.config.update("jax_platforms", "cpu")
+    from mx_rcnn_tpu.utils.compile_cache import configure_cpu_cache
+
+    configure_cpu_cache(REPO_ROOT)
+
+
+# -- internal subprocess modes ------------------------------------------------
+
+
+def child_main(argv: list[str]) -> int:
+    """Run the real train CLI hermetically (the orchestrator's workload)."""
+    _hermetic_cpu()
+    from mx_rcnn_tpu.cli import train_cli
+
+    return train_cli.cli(argv)
+
+
+def compare_main(dir_a: str, dir_b: str) -> int:
+    """Bitwise-compare the newest checkpoints of two run dirs."""
+    _hermetic_cpu()
+    import numpy as np
+
+    import jax
+    from mx_rcnn_tpu.train.checkpoint import restore_raw
+
+    a, b = restore_raw(dir_a), restore_raw(dir_b)
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        print(json.dumps({"equal": False, "why": "tree structure differs"}))
+        return 1
+    diffs = [
+        i for i, (x, y) in enumerate(zip(fa, fb))
+        if not np.array_equal(np.asarray(x), np.asarray(y))
+    ]
+    print(json.dumps({"equal": not diffs, "leaves": len(fa), "diffs": diffs}))
+    return 1 if diffs else 0
+
+
+# -- orchestrator -------------------------------------------------------------
+
+
+def train_argv(workdir: str, steps: int, resume: bool = False) -> list[str]:
+    argv = [
+        sys.executable, os.path.abspath(__file__), "--child", "--",
+        "--config", CONFIG, "--workdir", workdir,
+        "--steps", str(steps), "--no-eval",
+        "--set", f"train.checkpoint_every={CKPT_EVERY}",
+        "--set", f"train.log_every={LOG_EVERY}",
+    ]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
+def ckpt_dir(workdir: str) -> str:
+    return os.path.join(workdir, CONFIG, "ckpt")
+
+
+def finalized_steps(workdir: str) -> list[int]:
+    """Finalized orbax step dirs (bare ints; tmp dirs have suffixes)."""
+    d = ckpt_dir(workdir)
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        int(n) for n in os.listdir(d)
+        if n.isdigit() and os.path.isdir(os.path.join(d, n))
+    )
+
+
+def metrics_rows(workdir: str) -> list[dict]:
+    path = os.path.join(workdir, CONFIG, "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for line in f:
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+    return rows
+
+
+class Child:
+    def __init__(self, workdir: str, steps: int, resume: bool = False,
+                 env: dict | None = None) -> None:
+        self.log_path = os.path.join(
+            workdir, f"child-{'resume' if resume else 'first'}.log"
+        )
+        os.makedirs(workdir, exist_ok=True)
+        self._log = open(self.log_path, "a")
+        self.proc = subprocess.Popen(
+            train_argv(workdir, steps, resume),
+            stdout=self._log, stderr=subprocess.STDOUT,
+            env={**os.environ, **(env or {})}, cwd=REPO_ROOT,
+        )
+
+    def wait(self, timeout: float) -> int:
+        try:
+            return self.proc.wait(timeout)
+        finally:
+            self._log.close()
+
+    def signal(self, sig: int) -> None:
+        self.proc.send_signal(sig)
+
+    def log_tail(self, n: int = 30) -> str:
+        with open(self.log_path) as f:
+            return "".join(f.readlines()[-n:])
+
+
+def wait_for(predicate, timeout: float, poll: float = 0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(poll)
+    return None
+
+
+def run_to_completion(workdir: str, steps: int, timeout: float,
+                      resume: bool = False, env: dict | None = None) -> int:
+    child = Child(workdir, steps, resume=resume, env=env)
+    rc = child.wait(timeout)
+    if rc not in (0,):
+        raise AssertionError(
+            f"child exited {rc} (log: {child.log_path})\n{child.log_tail()}"
+        )
+    return rc
+
+
+def bitwise_equal(workdir_a: str, workdir_b: str, timeout: float) -> bool:
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--compare",
+         ckpt_dir(workdir_a), ckpt_dir(workdir_b)],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO_ROOT,
+    )
+    sys.stderr.write(out.stdout + out.stderr)
+    return out.returncode == 0
+
+
+def interrupt_at_checkpoint(workdir: str, steps: int, sig: int,
+                            min_step: int, timeout: float) -> int:
+    """Start a run, deliver ``sig`` once a checkpoint >= min_step is
+    finalized, return the exit code."""
+    child = Child(workdir, steps)
+    hit = wait_for(
+        lambda: [s for s in finalized_steps(workdir) if s >= min_step],
+        timeout,
+    )
+    if not hit:
+        child.signal(signal.SIGKILL)
+        child.wait(timeout)
+        raise AssertionError(
+            f"no checkpoint >= {min_step} appeared within {timeout}s "
+            f"(log: {child.log_path})\n{child.log_tail()}"
+        )
+    child.signal(sig)
+    return child.wait(timeout)
+
+
+def scenario_baseline(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "baseline")
+    done = finalized_steps(wd)
+    if done and done[-1] == steps:  # idempotent across partial reruns
+        return {"final_step": steps, "reused": True}
+    run_to_completion(wd, steps, timeout)
+    final = finalized_steps(wd)
+    assert final and final[-1] == steps, f"final checkpoints: {final}"
+    return {"final_step": final[-1]}
+
+
+def scenario_sigkill(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "sigkill")
+    rc = interrupt_at_checkpoint(
+        wd, steps, signal.SIGKILL, min_step=CKPT_EVERY, timeout=timeout
+    )
+    assert rc == -signal.SIGKILL, f"expected SIGKILL death, got rc={rc}"
+    interrupted_at = finalized_steps(wd)[-1]
+    assert interrupted_at < steps, "child finished before the kill landed"
+    run_to_completion(wd, steps, timeout, resume=True)
+    assert finalized_steps(wd)[-1] == steps
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "resumed-after-SIGKILL params differ from the uninterrupted run"
+    )
+    return {"killed_after_step": interrupted_at, "bit_identical": True}
+
+
+def scenario_sigterm(root: str, steps: int, timeout: float) -> dict:
+    # Pinned contract (EX_TEMPFAIL) — mirrored from train/preemption.py so
+    # the orchestrator stays import-free; test_robustness pins the value.
+    RESUMABLE_EXIT_CODE = 75
+
+    wd = os.path.join(root, "sigterm")
+    rc = interrupt_at_checkpoint(
+        wd, steps, signal.SIGTERM, min_step=CKPT_EVERY, timeout=timeout
+    )
+    assert rc == RESUMABLE_EXIT_CODE, (
+        f"expected resumable exit {RESUMABLE_EXIT_CODE}, got {rc}"
+    )
+    emergency = finalized_steps(wd)[-1]
+    assert emergency < steps
+    run_to_completion(wd, steps, timeout, resume=True)
+    assert finalized_steps(wd)[-1] == steps
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "resumed-after-SIGTERM params differ from the uninterrupted run"
+    )
+    return {"emergency_step": emergency, "bit_identical": True}
+
+
+def scenario_nan(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "nan")
+    poison = CKPT_EVERY + 2  # inside the second checkpoint interval
+    run_to_completion(
+        wd, steps, timeout, env={"MX_RCNN_CHAOS_NAN_STEPS": str(poison)}
+    )
+    assert finalized_steps(wd)[-1] == steps
+    rows = metrics_rows(wd)
+    assert rows and rows[-1]["step"] == steps, f"metrics rows: {rows}"
+    bad = [
+        (r["step"], k) for r in rows for k, v in r.items()
+        if isinstance(v, float) and v != v  # NaN
+    ]
+    assert not bad, f"non-finite metrics survived the rollback: {bad}"
+    return {"poisoned_batch": poison, "metric_rows": len(rows)}
+
+
+def scenario_truncate(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "truncate")
+    rc = interrupt_at_checkpoint(
+        wd, steps, signal.SIGKILL, min_step=2 * CKPT_EVERY, timeout=timeout
+    )
+    assert rc == -signal.SIGKILL
+    latest = finalized_steps(wd)[-1]
+    # Truncate every file of the newest checkpoint — a kill mid-write.
+    clipped = 0
+    for dirpath, _, files in os.walk(os.path.join(ckpt_dir(wd), str(latest))):
+        for name in files:
+            path = os.path.join(dirpath, name)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(size // 2)
+            clipped += 1
+    assert clipped, f"checkpoint step {latest} has no files to truncate"
+    run_to_completion(wd, steps, timeout, resume=True)
+    assert finalized_steps(wd)[-1] == steps
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "recovery past the truncated checkpoint lost bit-identity"
+    )
+    return {"truncated_step": latest, "files_clipped": clipped,
+            "bit_identical": True}
+
+
+SCENARIOS = {
+    "baseline": scenario_baseline,
+    "sigkill": scenario_sigkill,
+    "sigterm": scenario_sigterm,
+    "nan": scenario_nan,
+    "truncate": scenario_truncate,
+}
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--child":
+        rest = argv[2:] if argv[1:2] == ["--"] else argv[1:]
+        return child_main(rest)
+    if argv and argv[0] == "--compare":
+        return compare_main(argv[1], argv[2])
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default="all",
+                   choices=["all", *SCENARIOS])
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--workdir", default=None,
+                   help="scratch root (default: a fresh temp dir)")
+    p.add_argument("--keep", action="store_true",
+                   help="keep the scratch root for inspection")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="per-child wall clock budget (seconds)")
+    args = p.parse_args(argv)
+
+    root = args.workdir or tempfile.mkdtemp(prefix="mx_rcnn_chaos_")
+    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    # Every recovery scenario compares against baseline's checkpoint.
+    if "baseline" not in names:
+        names.insert(0, "baseline")
+
+    results: dict[str, dict] = {}
+    failed = []
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            r = SCENARIOS[name](root, args.steps, args.timeout)
+            r["ok"] = True
+        except (AssertionError, Exception) as e:  # noqa: BLE001 - report all
+            r = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            failed.append(name)
+        r["seconds"] = round(time.monotonic() - t0, 1)
+        results[name] = r
+        print(f"[chaos] {name}: {r}", file=sys.stderr)
+        if name == "baseline" and not r["ok"]:
+            break  # nothing to compare against
+    print(json.dumps({"root": root, "steps": args.steps, "results": results}))
+    if not args.keep and not failed:
+        shutil.rmtree(root, ignore_errors=True)
+    elif failed:
+        print(f"[chaos] artifacts kept at {root}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
