@@ -22,3 +22,16 @@ Layers (bottom-up, see SURVEY.md section 8):
 """
 
 __version__ = "0.1.0"
+
+import jax as _jax
+
+# Sharding-invariant PRNG, unconditionally.  The legacy threefry lowering
+# leaves its iota counter generation to the whims of the SPMD partitioner;
+# inside a large partitioned step we have observed it produce *different
+# bits for the same key* between a pure-DP and a spatially-partitioned
+# compilation (and upstream jax made this mode the default in later
+# releases for the same reason).  Every determinism contract in this repo —
+# spatial-vs-DP metric parity, bit-exact chaos resume, double-compile
+# determinism — sits on top of "same key => same bits", so opt in at import.
+_jax.config.update("jax_threefry_partitionable", True)
+del _jax

@@ -78,18 +78,41 @@ def detect_image(cfg: Config, variables, image: np.ndarray,
     return d["boxes"], d["scores"], d["classes"], d.get("masks")
 
 
+def load_demo_image(path: str) -> np.ndarray:
+    """Read one RGB image or raise SystemExit with a one-line diagnosis.
+
+    A missing path, a directory, or bytes PIL cannot decode are operator
+    errors, not bugs — the CLI reports them cleanly (nonzero exit, no
+    traceback) instead of dumping PIL internals."""
+    import os
+
+    from PIL import Image, UnidentifiedImageError
+
+    if not os.path.exists(path):
+        raise SystemExit(f"error: input image not found: {path}")
+    try:
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"))
+    except UnidentifiedImageError:
+        raise SystemExit(
+            f"error: {path} is not a decodable image (corrupt or "
+            "unsupported format)"
+        ) from None
+    except OSError as e:
+        raise SystemExit(f"error: could not read image {path}: {e}") from None
+
+
 def main(argv=None):
     args = parse_args(argv)
     setup_logging(args.verbose)
     cfg = config_from_args(args)
 
+    image = load_demo_image(args.image)
+
     import jax
 
     from mx_rcnn_tpu.parallel.step import eval_variables
 
-    from PIL import Image
-
-    image = np.asarray(Image.open(args.image).convert("RGB"))
     if args.random_params:
         from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
 
@@ -103,9 +126,22 @@ def main(argv=None):
             eval_variables(_restored_state(cfg, args.ckpt, args.step))
         )
 
-    boxes, scores, classes, masks = detect_image(
-        cfg, variables, image, mask_threshold=args.threshold
+    # The demo serves through the same engine production traffic uses
+    # (docs/serving.md): warmup-compiled programs, watchdog, typed errors.
+    from mx_rcnn_tpu.serve import ServeError, build_engine
+
+    try:
+        with build_engine(cfg, variables) as engine:
+            result = engine.infer(image)
+    except ServeError as e:
+        raise SystemExit(f"error: inference failed: {e}") from None
+    log.info(
+        "served at level %r in %.3fs", result["level"], result["latency_s"]
     )
+    boxes, scores, classes = (
+        result["boxes"], result["scores"], result["classes"],
+    )
+    masks = result.get("masks")
     class_names = None
     if cfg.data.dataset == "voc":
         from mx_rcnn_tpu.data.datasets import VOC_CLASSES

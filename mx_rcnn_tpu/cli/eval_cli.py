@@ -24,6 +24,10 @@ from mx_rcnn_tpu.config import Config
 
 log = logging.getLogger("mx_rcnn_tpu.eval")
 
+# Mirrored from train.preemption.RESUMABLE_EXIT_CODE without importing it
+# at module scope (parse_args must not drag in jax).
+_RESUMABLE_CODE = 75
+
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
@@ -76,6 +80,33 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="draw the first N evaluated images with detections into "
         "<workdir>/<config>/vis (reference pred_eval vis=True parity)",
     )
+    p.add_argument(
+        "--resumable", action="store_true",
+        help="preemption-safe evaluation: per-shard detection checkpoints "
+        "under --shard-dir, SIGTERM flushes the in-flight shard and exits "
+        f"{_RESUMABLE_CODE} for the supervisor to re-run with --resume",
+    )
+    p.add_argument(
+        "--shard-dir", default=None, metavar="DIR",
+        help="where shard files + manifest live (implies --resumable; "
+        "default <workdir>/<config>/eval_shards)",
+    )
+    p.add_argument(
+        "--shard-size", type=int, default=8, metavar="N",
+        help="eval batches per shard checkpoint (default 8)",
+    )
+    p.add_argument(
+        "--shard-retries", type=int, default=1, metavar="N",
+        help="retries per failed shard before giving up (default 1)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="skip shards already on disk (schedule fingerprint checked)",
+    )
+    p.add_argument(
+        "--limit", type=int, default=None, metavar="N",
+        help="evaluate only the first N images (smoke/chaos runs)",
+    )
     return p.parse_args(argv)
 
 
@@ -84,6 +115,7 @@ def _eval_loader(
     batch_size: int = 1,
     with_masks: bool = False,
     proposals_path: Optional[str] = None,
+    limit: Optional[int] = None,
 ):
     from mx_rcnn_tpu.data import DetectionLoader, build_dataset, load_proposals
 
@@ -92,6 +124,11 @@ def _eval_loader(
     proposals = load_proposals(proposals_path) if proposals_path else None
     dataset = build_dataset(cfg.data, train=False)
     roidb = dataset.roidb()
+    if limit is not None:
+        # Smoke/chaos runs: evaluate a prefix of the split.  The metric
+        # roidb is sliced identically so absent images don't score as
+        # misses.
+        roidb = roidb[:limit]
     loader = DetectionLoader(
         roidb, cfg.data, batch_size=batch_size, train=False,
         with_masks=with_masks,
@@ -101,6 +138,13 @@ def _eval_loader(
         # global batch for lockstep multi-host iteration (loader docs).
         rank=jax.process_index(),
         world=jax.process_count(),
+        # Same rot-tolerance contract as training: unreadable images are
+        # quarantined + blank-substituted, never a crashed eval.
+        num_classes=cfg.model.num_classes,
+        quarantine_path=(
+            f"{cfg.workdir}/{cfg.name}/quarantine.jsonl"
+            if cfg.workdir else None
+        ),
     )
     return dataset, roidb, loader
 
@@ -135,6 +179,11 @@ def run_eval(
     proposals_path: Optional[str] = None,
     coco_results_path: Optional[str] = None,
     voc_dets_dir: Optional[str] = None,
+    shard_dir: Optional[str] = None,
+    shard_size: int = 8,
+    resume: bool = False,
+    shard_retries: int = 1,
+    limit: Optional[int] = None,
 ) -> dict:
     """Evaluate a state (or a restored checkpoint) on the config's val split.
 
@@ -143,7 +192,13 @@ def run_eval(
     area metric otherwise.
 
     ``proposals_path``: score an external proposal pkl instead of running
-    the RPN (reference ``test_rcnn --has_rpn false`` Fast R-CNN testing)."""
+    the RPN (reference ``test_rcnn --has_rpn false`` Fast R-CNN testing).
+
+    ``shard_dir`` switches to preemption-safe sharded evaluation
+    (docs/serving.md): per-shard detection checkpoints, ``resume`` skipping
+    completed shards, SIGTERM/SIGINT draining the in-flight shard and
+    raising ``Preempted`` (the CLI maps it to exit 75).  Single-process
+    only."""
     import jax
 
     from mx_rcnn_tpu.cli.common import default_use_07_metric
@@ -188,6 +243,7 @@ def run_eval(
         cfg,
         batch_size=(mesh.size if mesh is not None else 1) * per_chip,
         proposals_path=proposals_path,
+        limit=limit,
     )
     style = "voc" if cfg.data.dataset == "voc" else "coco"
     class_names = None
@@ -214,24 +270,37 @@ def run_eval(
     label_to_cat = (
         getattr(dataset, "label_to_cat", None) if coco_results_path else None
     )
-    metrics = pred_eval(
-        eval_step,
-        variables,
-        loader,
-        roidb,
-        cfg.model.num_classes,
-        style=style,
-        class_names=class_names,
-        use_07_metric=use_07_metric,
-        dump_path=dump_path,
-        vis_dir=f"{cfg.workdir}/{cfg.name}/vis" if vis_count > 0 else None,
-        vis_count=vis_count,
-        mesh=mesh,
-        coco_results_path=coco_results_path,
-        label_to_cat=label_to_cat,
-        voc_dets_dir=voc_dets_dir,
-        voc_imageset=submission_imageset(cfg),
-    )
+    import contextlib
+
+    from mx_rcnn_tpu.train.preemption import PreemptionGuard
+
+    # The guard turns SIGTERM/SIGINT into a shard-boundary drain; without
+    # sharding there is no safe boundary to drain to, so don't install it.
+    guard_cm = PreemptionGuard() if shard_dir else contextlib.nullcontext()
+    with guard_cm as guard:
+        metrics = pred_eval(
+            eval_step,
+            variables,
+            loader,
+            roidb,
+            cfg.model.num_classes,
+            style=style,
+            class_names=class_names,
+            use_07_metric=use_07_metric,
+            dump_path=dump_path,
+            vis_dir=f"{cfg.workdir}/{cfg.name}/vis" if vis_count > 0 else None,
+            vis_count=vis_count,
+            mesh=mesh,
+            coco_results_path=coco_results_path,
+            label_to_cat=label_to_cat,
+            voc_dets_dir=voc_dets_dir,
+            voc_imageset=submission_imageset(cfg),
+            shard_dir=shard_dir,
+            shard_size=shard_size,
+            resume=resume,
+            shard_retries=shard_retries,
+            guard=guard,
+        )
     for k, v in sorted(metrics.items()):
         log.info("%s = %.4f", k, v)
     return metrics
@@ -347,10 +416,17 @@ def main(argv=None) -> dict:
     if args.proposals_split and not args.proposals:
         raise SystemExit("--proposals-split only applies with --proposals")
     if args.proposals:
+        if args.resumable or args.shard_dir or args.resume:
+            raise SystemExit("--proposals does not support sharded/resumable mode")
         return dump_proposals(
             cfg, args.proposals, ckpt_dir=args.ckpt, step=args.step,
             train_split=args.proposals_split == "train",
         )
+    if args.resume and not (args.resumable or args.shard_dir):
+        raise SystemExit("--resume requires --resumable (or --shard-dir)")
+    shard_dir = args.shard_dir
+    if args.resumable and not shard_dir:
+        shard_dir = f"{cfg.workdir}/{cfg.name}/eval_shards"
     return run_eval(
         cfg,
         ckpt_dir=args.ckpt,
@@ -361,6 +437,11 @@ def main(argv=None) -> dict:
         proposals_path=args.from_proposals,
         coco_results_path=args.dump_coco,
         voc_dets_dir=args.dump_voc,
+        shard_dir=shard_dir,
+        shard_size=args.shard_size,
+        resume=args.resume,
+        shard_retries=args.shard_retries,
+        limit=args.limit,
     )
 
 
@@ -368,10 +449,25 @@ def cli(argv=None) -> int:
     """Console-script entry point ([project.scripts]).  ``main`` returns
     its result dict for programmatic callers; returning that from a
     console script would make ``sys.exit`` treat the truthy dict as a
-    FAILURE exit status, so discard it and return 0 explicitly."""
-    main(argv)
+    FAILURE exit status, so discard it and return 0 explicitly.
+
+    A preemption during --resumable eval exits with the distinct
+    RESUMABLE_EXIT_CODE after the in-flight shard lands, so supervisors
+    can tell "requeue with --resume" from a real failure."""
+    from mx_rcnn_tpu.train.preemption import RESUMABLE_EXIT_CODE, Preempted
+
+    try:
+        main(argv)
+    except Preempted as p:
+        log.warning(
+            "eval preempted after shard %d (shards in %s); exiting %d — "
+            "requeue with --resume", p.step, p.ckpt_dir, RESUMABLE_EXIT_CODE,
+        )
+        return RESUMABLE_EXIT_CODE
     return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(cli())

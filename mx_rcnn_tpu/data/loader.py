@@ -50,6 +50,13 @@ log = logging.getLogger("mx_rcnn_tpu")
 # model or the schedule.
 CHAOS_NAN_ENV = "MX_RCNN_CHAOS_NAN_STEPS"
 
+# tools/chaos.py fault hook: comma-separated image_ids whose pixel load
+# RAISES (as a corrupt/unreadable file would) — drives the retry +
+# quarantine + blank-substitution path against real loaders, including
+# in-memory synthetic records that can't otherwise fail.  Active for
+# training AND eval (the eval_corrupt chaos scenario).
+CHAOS_BAD_IMAGES_ENV = "MX_RCNN_CHAOS_BAD_IMAGES"
+
 # Box-relative resolution at which gt instance masks are rasterized on host;
 # the device crops these to the mask head's target size per sampled roi.
 GT_MASK_SIZE = 112
@@ -75,6 +82,36 @@ def load_proposals(path: str) -> dict:
             )
         break  # spot-check one entry; full arrays validate lazily per image
     return props
+
+
+def annotation_error(rec: RoiRecord, num_classes: Optional[int] = None) -> Optional[str]:
+    """Why this record's annotations are unusable, or None if they're fine.
+
+    Mirrors the image-quarantine contract for the OTHER way a dataset rots
+    in place: a truncated/corrupt annotation record (malformed box arrays,
+    non-finite or inverted coordinates, out-of-range class ids) used to
+    crash mid-epoch deep inside ``_example``; now it is detected up front
+    and the record is quarantined + blank-substituted instead.
+    """
+    boxes = np.asarray(rec.boxes)
+    if boxes.ndim != 2 or boxes.shape[1] != 4:
+        return f"boxes shape {boxes.shape} is not (n, 4)"
+    if boxes.dtype.kind not in "fiu" or not np.isfinite(
+        boxes.astype(np.float64, copy=False)
+    ).all():
+        return "non-finite or non-numeric box coordinates"
+    if (boxes[:, 2] < boxes[:, 0]).any() or (boxes[:, 3] < boxes[:, 1]).any():
+        return "inverted box (x2 < x1 or y2 < y1)"
+    cls = np.asarray(rec.gt_classes)
+    if cls.shape != (len(boxes),):
+        return f"gt_classes shape {cls.shape} does not match {len(boxes)} boxes"
+    if len(cls) and cls.min() < 1:
+        return "class id < 1 (foreground labels are 1-based)"
+    if num_classes is not None and len(cls) and cls.max() >= num_classes:
+        return f"class id {int(cls.max())} >= num_classes {num_classes}"
+    if rec.ignore is not None and np.asarray(rec.ignore).shape != (len(boxes),):
+        return "ignore flags do not match the box count"
+    return None
 
 
 def load_image(rec: RoiRecord) -> np.ndarray:
@@ -150,6 +187,7 @@ class DetectionLoader:
         run_length: int = 1,
         quarantine_path: Optional[str] = None,
         io_retries: int = 2,
+        num_classes: Optional[int] = None,
     ) -> None:
         """``proposals``: image_id → {"boxes": (n, 4) ORIGINAL-image coords,
         "scores": (n,)} (the ``test.py --proposals`` pkl format) — shipped
@@ -160,12 +198,42 @@ class DetectionLoader:
         ``run_length``: emit training batches in runs of this many
         consecutive SAME-CANVAS batches (steps_per_call stacking needs K
         identically-shaped batches per device call).  Irrelevant for
-        square canvases — every batch shares the shape anyway."""
+        square canvases — every batch shares the shape anyway.
+
+        ``num_classes``: when given, annotation validation additionally
+        rejects class ids outside ``[1, num_classes)``."""
+        # I/O hardening (docs/robustness.md): a record whose pixels cannot
+        # be loaded after bounded retries is quarantined — recorded to
+        # ``quarantine_path`` and substituted with a black canvas whose gt
+        # slots are all invalid — instead of killing the run.  The batch
+        # SCHEDULE never depends on load success (it is derived from the
+        # roidb alone), so substitution is schedule-deterministic and
+        # multi-host ranks stay in lockstep: shapes and collectives are
+        # unchanged, only local pixel content differs.
+        self.quarantine_path = quarantine_path
+        self.io_retries = max(int(io_retries), 0)
+        self._quarantine_lock = threading.Lock()
+        self._quarantined: set[str] = set()
+        # Annotation hardening (same contract as pixels): a corrupt or
+        # truncated annotation record is detected HERE — before the first
+        # epoch touches it — quarantined, and blank-substituted at assembly.
+        # The record stays in the roidb, so the schedule (and therefore
+        # every host's collectives) is identical to a clean run.
+        self._bad_annotations: dict[str, str] = {}
+        for r in roidb:
+            why = annotation_error(r, num_classes)
+            if why is not None and r.image_id not in self._bad_annotations:
+                self._bad_annotations[r.image_id] = why
+                self._quarantine(r, ValueError(why), reason="annotation")
         # The flag decides the Batch pytree structure (gt_ignore present or
         # None) and therefore the jitted program, so it is computed over
         # the full roidb — every host must agree even when all the ignore
-        # regions happen to land in one host's rows.
-        self.with_ignore = any(r.ignore_flags.any() for r in roidb)
+        # regions happen to land in one host's rows.  Quarantined-annotation
+        # records contribute nothing (their gt is blanked at assembly).
+        self.with_ignore = any(
+            r.ignore_flags.any() for r in roidb
+            if r.image_id not in self._bad_annotations
+        )
         # Every host keeps the FULL roidb and derives the SAME global batch
         # schedule (shuffle, orientation buckets, flips); a host then
         # assembles only its rank's rows of each global batch.  Per-host
@@ -218,18 +286,15 @@ class DetectionLoader:
                 )
         if not self.roidb:
             raise ValueError("empty roidb shard")
-        # I/O hardening (docs/robustness.md): a record whose pixels cannot
-        # be loaded after bounded retries is quarantined — recorded to
-        # ``quarantine_path`` and substituted with a black canvas whose gt
-        # slots are all invalid — instead of killing the run.  The batch
-        # SCHEDULE never depends on load success (it is derived from the
-        # roidb alone), so substitution is schedule-deterministic and
-        # multi-host ranks stay in lockstep: shapes and collectives are
-        # unchanged, only local pixel content differs.
-        self.quarantine_path = quarantine_path
-        self.io_retries = max(int(io_retries), 0)
-        self._quarantine_lock = threading.Lock()
-        self._quarantined: set[str] = set()
+        bad_env = os.environ.get(CHAOS_BAD_IMAGES_ENV, "")
+        self._chaos_bad_images = frozenset(
+            tok.strip() for tok in bad_env.split(",") if tok.strip()
+        )
+        if self._chaos_bad_images:
+            log.warning(
+                "chaos: simulated-corrupt image ids armed: %s",
+                sorted(self._chaos_bad_images),
+            )
         nan_env = os.environ.get(CHAOS_NAN_ENV, "") if train else ""
         self._nan_steps = frozenset(
             int(tok) for tok in nan_env.split(",") if tok.strip()
@@ -289,15 +354,18 @@ class DetectionLoader:
 
     # -- single image ------------------------------------------------------
 
-    def _quarantine(self, rec: RoiRecord, error: BaseException) -> None:
+    def _quarantine(
+        self, rec: RoiRecord, error: BaseException, reason: str = "io"
+    ) -> None:
+        retries = self.io_retries if reason == "io" else 0
         with self._quarantine_lock:
             if rec.image_id in self._quarantined:
                 return  # already recorded; don't re-log every epoch
             self._quarantined.add(rec.image_id)
             log.error(
-                "quarantining image %r (%s: %s) after %d retries; "
+                "quarantining image %r (%s; %s: %s) after %d retries; "
                 "substituting a blank example",
-                rec.image_id, type(error).__name__, error, self.io_retries,
+                rec.image_id, reason, type(error).__name__, error, retries,
             )
             if self.quarantine_path is None:
                 return
@@ -308,25 +376,51 @@ class DetectionLoader:
                 f.write(json.dumps({
                     "image_id": rec.image_id,
                     "path": rec.image_path,
+                    "reason": reason,
                     "error": f"{type(error).__name__}: {error}",
-                    "retries": self.io_retries,
+                    "retries": retries,
                 }) + "\n")
 
+    def _blank_pixels(self, rec: RoiRecord) -> np.ndarray:
+        """A zero canvas in the record's NATIVE dtype — a uint8 blank inside
+        an otherwise-float (synthetic/host-normalized) batch would trip the
+        mixed-dtype guard in ``_assemble``."""
+        if rec.image_array is not None:
+            return np.zeros_like(rec.image_array)
+        return np.zeros((rec.height, rec.width, 3), np.uint8)
+
     def _load_image(self, rec: RoiRecord) -> tuple[np.ndarray, bool]:
-        """``(pixels, ok)`` — bounded retry on I/O errors, then a black
-        uint8 canvas with ``ok=False`` (the caller invalidates the gt)."""
+        """``(pixels, ok)`` — bounded retry on I/O errors, then a blank
+        canvas with ``ok=False`` (the caller invalidates the gt)."""
         err: Optional[BaseException] = None
         for attempt in range(self.io_retries + 1):
             try:
+                if rec.image_id in self._chaos_bad_images:
+                    raise ValueError("chaos: simulated corrupt image")
                 return load_image(rec), True
             except (OSError, ValueError) as e:
                 err = e
                 if attempt < self.io_retries:
                     time.sleep(0.1 * (2 ** attempt))
         self._quarantine(rec, err)
-        return np.zeros((rec.height, rec.width, 3), np.uint8), False
+        return self._blank_pixels(rec), False
 
     def _example(self, rec: RoiRecord, flip: bool):
+        if rec.image_id in self._bad_annotations:
+            # Quarantined annotations take the same substitution as
+            # quarantined pixels: blank canvas, zero gt slots.  The stand-in
+            # record never touches the (possibly malformed) box/class arrays.
+            import dataclasses
+
+            rec = dataclasses.replace(
+                rec,
+                boxes=np.zeros((0, 4), np.float32),
+                gt_classes=np.zeros((0,), np.int32),
+                ignore=None,
+                masks=None,
+                image_array=self._blank_pixels(rec),
+                image_path="",
+            )
         img, img_ok = self._load_image(rec)
         boxes = rec.boxes
         if flip:
@@ -539,19 +633,27 @@ class DetectionLoader:
                 )
                 yield pending.popleft().result()
 
-    def _eval_batches(self):
-        # Non-square canvases: evaluate landscape images first, then
-        # portrait, each in roidb order — every batch shares one canvas
-        # (two compiled eval programs).  Detections map back through the
-        # yielded recs, so the reordering is invisible to the evaluator.
-        #
-        # Multi-host (world > 1): every host walks the SAME global schedule
-        # derived from the full roidb, assembles only its rank's rows of
-        # each padded global batch, and yields that local slice together
-        # with the global batch's records — per-step collectives stay in
-        # lockstep by construction, and rank-local batches concatenate into
-        # exactly the single-host global batch (shard_batch assembles them
-        # into one global array).
+    def eval_specs(self) -> list[tuple[list[RoiRecord], list[RoiRecord]]]:
+        """The GLOBAL eval batch schedule with NO pixel decode: one
+        ``(local_rows, global_records)`` entry per eval batch.
+
+        This is the schedule ``_eval_batches`` assembles pixels for; it is
+        exposed separately so resumable evaluation (evalutil/pred_eval.py)
+        can fingerprint the schedule, partition it into shards, and skip
+        completed shards without paying a decode for batches it will never
+        run.
+
+        Non-square canvases: landscape images first, then portrait, each in
+        roidb order — every batch shares one canvas (two compiled eval
+        programs).  Detections map back through the records, so the
+        reordering is invisible to the evaluator.
+
+        Multi-host (world > 1): every host derives the SAME global schedule
+        from the full roidb; ``local_rows`` is this rank's slice of each
+        padded global batch — per-step collectives stay in lockstep by
+        construction, and rank-local batches concatenate into exactly the
+        single-host global batch.
+        """
         rank, world = self._rank, self._world
         local = self.batch_size // world
         if self._square_canvas:
@@ -561,24 +663,35 @@ class DetectionLoader:
                 [r for r in self.roidb if r.aspect >= 1],
                 [r for r in self.roidb if r.aspect < 1],
             ]
+        specs = []
         for group in groups:
             for i in range(0, len(group), self.batch_size):
                 recs = group[i : i + self.batch_size]
                 pad = self.batch_size - len(recs)
                 padded = recs + [recs[-1]] * pad
-                rows = padded[rank * local : (rank + 1) * local]
-                batch = self._assemble(rows, [False] * len(rows))
-                yield batch, recs
+                specs.append((padded[rank * local : (rank + 1) * local], recs))
+        return specs
+
+    def eval_batch_range(self, start: int = 0, stop: Optional[int] = None):
+        """Assemble and yield eval batches ``start:stop`` of the global
+        schedule (``eval_specs`` order).  Sharded/resumable evaluation runs
+        each shard as one contiguous range and never decodes pixels for
+        batches outside it."""
+        for rows, recs in self.eval_specs()[start:stop]:
+            yield self._assemble(rows, [False] * len(rows)), recs
+
+    def _eval_batches(self, skip_batches: int = 0):
+        return self.eval_batch_range(skip_batches)
 
     def __iter__(self):
         return self.iter_from()
 
     def iter_from(self, skip_batches: int = 0):
-        """Iterate, skipping the first ``skip_batches`` training batches
-        (resume continuity: step k of a resumed run sees the batch step k
-        of an uninterrupted run would have)."""
+        """Iterate, skipping the first ``skip_batches`` batches (resume
+        continuity: step k of a resumed run sees the batch step k of an
+        uninterrupted run would have — training and eval alike)."""
         if not self.train:
-            return self._eval_batches()
+            return self._eval_batches(skip_batches)
         it = self._train_batches(skip_batches)
         if not self.prefetch:
             return it

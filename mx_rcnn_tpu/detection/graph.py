@@ -94,7 +94,7 @@ def level_anchors(
             base_size=stride, ratios=cfg.anchors.ratios, scales=cfg.anchors.scales
         )
         _, h, w, _ = feats[lvl].shape
-        out[lvl] = shifted_anchors(jnp.asarray(base), stride, h, w)
+        out[lvl] = shifted_anchors(base, stride, h, w)
     return out
 
 

@@ -9,10 +9,16 @@ detections).
 """
 
 from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
-from mx_rcnn_tpu.evalutil.detections import load_detections, save_detections
+from mx_rcnn_tpu.evalutil.detections import (
+    detections_from_json,
+    load_detections,
+    save_detections,
+)
 from mx_rcnn_tpu.evalutil.pred_eval import (
     collect_detections,
+    collect_detections_sharded,
     evaluate_detections,
+    merge_detection_shards,
     pred_eval,
 )
 from mx_rcnn_tpu.evalutil.submission import (
@@ -26,8 +32,11 @@ from mx_rcnn_tpu.evalutil.voc_eval import voc_ap, voc_eval
 __all__ = [
     "CocoEvaluator",
     "collect_detections",
+    "collect_detections_sharded",
+    "detections_from_json",
     "evaluate_detections",
     "load_detections",
+    "merge_detection_shards",
     "pred_eval",
     "read_coco_results",
     "read_voc_dets",

@@ -33,9 +33,12 @@ def save_detections(path: str, per_image: dict[str, dict]) -> None:
         json.dump(ser, f)
 
 
-def load_detections(path: str) -> dict[str, dict]:
-    with open(path) as f:
-        raw = json.load(f)
+def detections_from_json(raw: dict) -> dict[str, dict]:
+    """Raw parsed-JSON dump (``save_detections`` format) → numpy arrays.
+
+    Factored out of :func:`load_detections` so sharded evaluation can merge
+    shard dumps at the raw-JSON level (byte-stable — the float32 round-trip
+    here is lossy) and still hand arrays to the evaluator."""
     out = {}
     for k, v in raw.items():
         entry = {
@@ -50,3 +53,9 @@ def load_detections(path: str) -> dict[str, dict]:
             ]
         out[k] = entry
     return out
+
+
+def load_detections(path: str) -> dict[str, dict]:
+    with open(path) as f:
+        raw = json.load(f)
+    return detections_from_json(raw)

@@ -9,6 +9,10 @@ un-letterbox boxes back to original image coordinates (the reference's
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
+import os
 from typing import Callable, Optional
 
 import jax
@@ -16,8 +20,10 @@ import numpy as np
 
 from mx_rcnn_tpu.data.loader import DetectionLoader
 from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
-from mx_rcnn_tpu.evalutil.detections import save_detections
+from mx_rcnn_tpu.evalutil.detections import detections_from_json, save_detections
 from mx_rcnn_tpu.evalutil.voc_eval import voc_mean_ap
+
+log = logging.getLogger("mx_rcnn_tpu")
 
 
 def device_eval_batches(loader: DetectionLoader, mesh=None):
@@ -64,6 +70,168 @@ def collect_detections(
             if progress:
                 progress(done)
     return out
+
+
+MANIFEST_NAME = "manifest.json"
+
+
+def shard_path(shard_dir: str, idx: int) -> str:
+    return os.path.join(shard_dir, f"shard-{idx:05d}.json")
+
+
+def eval_schedule_fingerprint(loader: DetectionLoader, shard_size: int) -> str:
+    """Hash of everything that determines which images land in which shard.
+
+    A resumed run may only reuse shard files written under the SAME batch
+    schedule — resuming a 2-image-per-batch dump into a 4-image-per-batch
+    run would silently evaluate some images twice and others never."""
+    h = hashlib.sha1()
+    h.update(f"bs={loader.batch_size};shard={shard_size}".encode())
+    for _, recs in loader.eval_specs():
+        for r in recs:
+            h.update(str(r.image_id).encode())
+            h.update(b"\x00")
+        h.update(b"\x01")
+    return h.hexdigest()
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def collect_detections_sharded(
+    eval_step: Callable,
+    variables,
+    loader: DetectionLoader,
+    shard_dir: str,
+    shard_size: int = 8,
+    resume: bool = False,
+    max_retries: int = 1,
+    guard=None,
+    progress: Optional[Callable[[int], None]] = None,
+) -> list[str]:
+    """Preemption-safe :func:`collect_detections`: the eval schedule is cut
+    into shards of ``shard_size`` batches; each finished shard is written
+    (atomically — tmp + ``os.replace``; presence means complete) under
+    ``shard_dir`` in ``save_detections`` format, so an interrupted run
+    resumes by re-running only the missing shards.
+
+    ``resume=False`` starts clean (stale shard files are deleted);
+    ``resume=True`` validates the manifest fingerprint and skips shards
+    whose file already exists.  A shard that raises is retried up to
+    ``max_retries`` times before the error propagates.  ``guard`` (a
+    :class:`~mx_rcnn_tpu.train.preemption.PreemptionGuard`) is polled at
+    shard boundaries: the in-progress shard is always finished and flushed,
+    then :class:`~mx_rcnn_tpu.train.preemption.Preempted` is raised for the
+    CLI to map to the resumable exit code.
+
+    Returns the ordered list of shard file paths.  Single-process only —
+    the sharded dump protocol has no multi-host story (run_eval gates it).
+    """
+    from mx_rcnn_tpu.evalutil.postprocess import unletterbox_detections
+    from mx_rcnn_tpu.train.preemption import Preempted
+
+    if shard_size < 1:
+        raise ValueError("shard_size must be >= 1")
+    specs = loader.eval_specs()
+    num_batches = len(specs)
+    num_shards = max(1, -(-num_batches // shard_size))
+    fingerprint = eval_schedule_fingerprint(loader, shard_size)
+    os.makedirs(shard_dir, exist_ok=True)
+    manifest_path = os.path.join(shard_dir, MANIFEST_NAME)
+    manifest = {
+        "fingerprint": fingerprint,
+        "batch_size": loader.batch_size,
+        "shard_size": shard_size,
+        "num_batches": num_batches,
+        "num_shards": num_shards,
+    }
+    if resume and os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            prev = json.load(f)
+        if prev.get("fingerprint") != fingerprint:
+            raise ValueError(
+                f"--resume refused: {shard_dir} was written under a "
+                "different eval schedule (dataset/batch-size/shard-size "
+                "changed); start fresh without --resume"
+            )
+    else:
+        # Fresh start: stale shard files from an older schedule must not
+        # merge into (or be skipped by) this run.
+        for name in os.listdir(shard_dir):
+            if name.startswith("shard-") and name.endswith(".json"):
+                os.remove(os.path.join(shard_dir, name))
+        _write_json_atomic(manifest_path, manifest)
+
+    done_images = 0
+    paths = []
+    for s in range(num_shards):
+        path = shard_path(shard_dir, s)
+        paths.append(path)
+        start, stop = s * shard_size, min((s + 1) * shard_size, num_batches)
+        n_images = sum(len(recs) for _, recs in specs[start:stop])
+        if resume and os.path.exists(path):
+            done_images += n_images
+            if progress:
+                progress(done_images)
+            continue
+        for attempt in range(max_retries + 1):
+            try:
+                shard_out: dict[str, dict] = {}
+                for batch, recs in loader.eval_batch_range(start, stop):
+                    batch = jax.tree_util.tree_map(np.asarray, batch)
+                    dets = jax.device_get(eval_step(variables, batch))
+                    for i, rec in enumerate(recs):
+                        shard_out[rec.image_id] = unletterbox_detections(
+                            dets.boxes[i], dets.scores[i], dets.classes[i],
+                            dets.valid[i],
+                            loader.record_scale(rec), rec.height, rec.width,
+                            masks=dets.masks[i] if dets.masks is not None else None,
+                            encode_rle=True,
+                        )
+                tmp = path + ".tmp"
+                save_detections(tmp, shard_out)
+                os.replace(tmp, path)
+                break
+            except Exception:
+                if attempt >= max_retries:
+                    raise
+                log.warning(
+                    "eval shard %d/%d failed (attempt %d/%d); retrying",
+                    s, num_shards, attempt + 1, max_retries + 1,
+                    exc_info=True,
+                )
+        done_images += n_images
+        if progress:
+            progress(done_images)
+        if guard is not None and guard.triggered:
+            # The shard that was in flight when the signal landed is on
+            # disk; tell the supervisor to re-run with --resume.
+            raise Preempted(s, shard_dir)
+    return paths
+
+
+def merge_detection_shards(
+    shard_paths: list[str], out_path: Optional[str] = None
+) -> dict:
+    """Merge shard dumps into one detections dict at the RAW JSON level.
+
+    Byte-stability is the point: ``save_detections`` writes float64 values
+    whose JSON text is the shortest round-trip repr; going through
+    ``load_detections`` (float32) and re-saving would perturb the text.
+    Merging parsed-JSON dicts and dumping keeps the final file byte-for-
+    byte identical between an uninterrupted run and any interrupted+resumed
+    run over the same schedule.  Returns the merged raw dict."""
+    merged: dict = {}
+    for p in shard_paths:
+        with open(p) as f:
+            merged.update(json.load(f))
+    if out_path:
+        _write_json_atomic(out_path, merged)
+    return merged
 
 
 def evaluate_detections(
@@ -199,17 +367,45 @@ def pred_eval(
     label_to_cat=None,
     voc_dets_dir: Optional[str] = None,
     voc_imageset: str = "test",
+    shard_dir: Optional[str] = None,
+    shard_size: int = 8,
+    resume: bool = False,
+    shard_retries: int = 1,
+    guard=None,
 ) -> dict[str, float]:
     """``coco_results_path`` / ``voc_dets_dir`` additionally write the
     official interchange artifacts (COCO results json in ORIGINAL sparse
     category ids via ``label_to_cat``; VOC comp4 det files) — the
     reference's ``evaluate_detections`` side-effect outputs that external
-    tools and the eval servers consume (SURVEY.md §3.6)."""
-    per_image = collect_detections(eval_step, variables, loader, mesh=mesh)
-    # Multi-host: every host holds the full (gathered) detections and
-    # computes identical metrics; artifacts are written once, by process 0.
-    if dump_path and jax.process_index() == 0:
-        save_detections(dump_path, per_image)
+    tools and the eval servers consume (SURVEY.md §3.6).
+
+    ``shard_dir`` switches inference to the preemption-safe sharded path
+    (:func:`collect_detections_sharded`): per-shard checkpoint files,
+    ``resume`` skipping completed shards, ``guard`` polled at shard
+    boundaries, and the final dump merged from the shard files at the raw
+    JSON level so it is byte-identical across interruptions."""
+    if shard_dir:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "sharded (resumable) evaluation is single-process only"
+            )
+        paths = collect_detections_sharded(
+            eval_step, variables, loader, shard_dir,
+            shard_size=shard_size, resume=resume,
+            max_retries=shard_retries, guard=guard,
+        )
+        raw = merge_detection_shards(paths, out_path=dump_path)
+        # Metrics come from the merged dump's parse, not live arrays:
+        # interrupted-and-resumed and uninterrupted runs score the exact
+        # same numbers because they score the exact same bytes.
+        per_image = detections_from_json(raw)
+    else:
+        per_image = collect_detections(eval_step, variables, loader, mesh=mesh)
+        # Multi-host: every host holds the full (gathered) detections and
+        # computes identical metrics; artifacts are written once, by
+        # process 0.
+        if dump_path and jax.process_index() == 0:
+            save_detections(dump_path, per_image)
     if (coco_results_path or voc_dets_dir) and jax.process_index() == 0:
         from mx_rcnn_tpu.evalutil.submission import write_submission_artifacts
 

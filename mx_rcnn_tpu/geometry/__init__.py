@@ -5,6 +5,7 @@ from mx_rcnn_tpu.geometry.boxes import (
     encode_boxes,
     ioa_matrix,
     iou_matrix,
+    snap,
     valid_box_mask,
 )
 from mx_rcnn_tpu.geometry.anchors import generate_base_anchors, shifted_anchors
@@ -22,6 +23,7 @@ __all__ = [
     "encode_boxes",
     "ioa_matrix",
     "iou_matrix",
+    "snap",
     "valid_box_mask",
     "generate_base_anchors",
     "shifted_anchors",

@@ -11,9 +11,6 @@ disappears entirely.
 
 from __future__ import annotations
 
-from functools import partial
-
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,17 +60,24 @@ def generate_base_anchors(
     ).astype(np.float32)
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
-def shifted_anchors(base_anchors: jnp.ndarray, stride: int, height: int, width: int):
+def shifted_anchors(base_anchors, stride: int, height: int, width: int):
     """Tile base anchors over an H x W feature grid.
 
     Returns (H*W*k, 4) anchors in input-image coordinates, ordered so that
     the anchor axis unrolls as (row-major spatial, then k) — matching how a
     (H, W, k*4) conv output reshapes to (H*W*k, 4).
+
+    Computed in host numpy and embedded as a literal constant: shapes are
+    static, so there is nothing to trace — and keeping the iota/meshgrid
+    subgraph out of the compiled program guarantees every compilation of a
+    step (pure-DP, spatially partitioned, different layout forms) consumes
+    bit-identical anchors instead of re-deriving them under whatever
+    partitioning XLA picks for the constant-folded grid.
     """
-    shift_x = jnp.arange(width, dtype=jnp.float32) * stride
-    shift_y = jnp.arange(height, dtype=jnp.float32) * stride
-    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (H, W)
-    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
-    out = shifts[:, :, None, :] + base_anchors[None, None, :, :]  # (H, W, k, 4)
-    return out.reshape(-1, 4)
+    base = np.asarray(base_anchors, dtype=np.float32)
+    shift_x = np.arange(width, dtype=np.float32) * stride
+    shift_y = np.arange(height, dtype=np.float32) * stride
+    sx, sy = np.meshgrid(shift_x, shift_y)  # (H, W)
+    shifts = np.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+    out = shifts[:, :, None, :] + base[None, None, :, :]  # (H, W, k, 4)
+    return jnp.asarray(out.reshape(-1, 4))

@@ -22,6 +22,30 @@ import jax.numpy as jnp
 # cannot overflow float32 (np.log(1000.0 / 16.0) in modern detectors).
 BBOX_XFORM_CLIP = 4.135166556742356
 
+# Grid spacing 2**-16 ~ 1.5e-5: orders of magnitude above cross-compilation
+# ulp noise, orders of magnitude below any IoU/score difference that could
+# matter to matching or ranking.
+SNAP_BITS = 16
+
+
+def snap(x: jnp.ndarray, bits: int = SNAP_BITS) -> jnp.ndarray:
+    """Round onto the exact ``2**-bits`` grid — bit-stable across programs.
+
+    Differently-partitioned (or differently laid-out) compilations of the
+    same graph make different fusion/FMA-contraction choices, leaving float
+    intermediates a few ulps apart.  Continuous consumers don't care, but
+    *discrete* ones — threshold compares, argmax ties, top-k ranking, NMS
+    suppression — flip, so the same batch trains on a different anchor/roi
+    sample purely because of how the program was sharded.  Snapping the
+    values feeding those comparisons makes them bit-identical across
+    compilations: the power-of-two scale, ``round``, and the scale back are
+    each exact in float32, so the only residual risk is an input sitting
+    within ulps of a grid midpoint.  Infinities pass through unchanged
+    (``-inf`` score masks survive).
+    """
+    scale = 2.0 ** bits
+    return jnp.round(x * scale) * (1.0 / scale)
+
 
 def _wh(boxes: jnp.ndarray, legacy_plus_one: bool = False):
     off = 1.0 if legacy_plus_one else 0.0

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mx_rcnn_tpu.geometry import iou_matrix
+from mx_rcnn_tpu.geometry import iou_matrix, snap
 
 
 def nms_mask(
@@ -66,7 +66,10 @@ def nms_mask(
     sboxes = jnp.take(boxes, order, axis=0)
     svalid = jnp.take(valid, order)
 
-    iou = iou_matrix(sboxes, sboxes)
+    # snap(): the > threshold suppression decision must not flip on
+    # cross-compilation ulp noise (see geometry.boxes.snap); one flipped
+    # suppression cascades through the whole greedy chain.
+    iou = snap(iou_matrix(sboxes, sboxes))
     upper = jnp.triu(jnp.ones((n, n), dtype=bool), k=1)
     suppress = (iou > iou_threshold) & upper & svalid[:, None] & svalid[None, :]
 

@@ -798,11 +798,27 @@ def sharded_multilevel_roi_align(
             pyramid, shard_rois, output_size, sampling_ratio, window, interpret
         )
 
-    return jax.shard_map(
-        fn,
-        mesh=mesh,
-        in_specs=(P(data_axis), P(data_axis)),
-        out_specs=P(data_axis),
-        axis_names={data_axis},
-        check_vma=False,
-    )(feature_pyramid, rois)
+    if hasattr(jax, "shard_map"):
+        wrapped = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(data_axis), P(data_axis)),
+            out_specs=P(data_axis),
+            axis_names={data_axis},
+            check_vma=False,
+        )
+    else:
+        # jax < 0.6: shard_map lives in jax.experimental; "manual over
+        # data_axis only" is spelled as auto=<every other axis>, and the
+        # vma check is the old check_rep flag.
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        wrapped = _shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(data_axis), P(data_axis)),
+            out_specs=P(data_axis),
+            auto=frozenset(mesh.axis_names) - {data_axis},
+            check_rep=False,
+        )
+    return wrapped(feature_pyramid, rois)

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mx_rcnn_tpu.geometry import clip_boxes, decode_boxes, valid_box_mask
+from mx_rcnn_tpu.geometry import clip_boxes, decode_boxes, snap, valid_box_mask
 from mx_rcnn_tpu.ops.nms import nms_indices
 
 
@@ -78,6 +78,11 @@ def _pre_nms_candidates(
     suppressed/invalid candidates at ``-inf`` score."""
     a = scores.shape[0]
     k = min(pre_nms_top_n, a)
+    # snap(): top-k ranking and the NMS visit order are discrete in the
+    # scores; snapped scores + index-stable tie-breaks (lax.top_k and
+    # argsort both prefer the lower index) give the same candidate ordering
+    # in every compilation of this graph (see geometry.boxes.snap).
+    scores = snap(scores)
 
     if topk_impl == "approx" and k < a:
         top_scores, top_idx = lax.approx_max_k(
@@ -93,6 +98,13 @@ def _pre_nms_candidates(
         jnp.take(deltas, top_idx, axis=0), jnp.take(anchors, top_idx, axis=0)
     )
     boxes = clip_boxes(boxes, image_height, image_width)
+    # snap to a 1/256-px grid: decode/clip arithmetic carries a few ulps of
+    # cross-compilation noise at coordinate scale (~1e-5 px), which is the
+    # same magnitude as the fine IoU snap grid downstream — snapping the
+    # coordinates themselves makes every IoU consumer (NMS here, roi
+    # sampling later) see bit-identical boxes.  1/256 px is far below
+    # anything detection quality can notice.
+    boxes = snap(boxes, bits=8)
 
     ok = valid_box_mask(boxes, min_size=min_size)
     masked_scores = jnp.where(ok, top_scores, -jnp.inf)

@@ -22,7 +22,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from mx_rcnn_tpu.geometry import encode_boxes, ioa_matrix, iou_matrix
+from mx_rcnn_tpu.geometry import encode_boxes, ioa_matrix, iou_matrix, snap
 
 
 def _ignore_overlap_mask(
@@ -41,7 +41,9 @@ def _ignore_overlap_mask(
     """
     if gt_ignore is None:
         return jnp.zeros(boxes.shape[0], bool)
-    ioa = ioa_matrix(boxes, gt_boxes) * gt_ignore[None, :].astype(boxes.dtype)
+    # snap(): the >= threshold compare must not flip on cross-compilation
+    # ulp noise (see geometry.boxes.snap).
+    ioa = snap(ioa_matrix(boxes, gt_boxes)) * gt_ignore[None, :].astype(boxes.dtype)
     return jnp.max(ioa, axis=1) >= threshold
 
 
@@ -127,7 +129,10 @@ def assign_anchors(
         & (anchors[:, 3] < image_height + allowed_border)
     )
 
-    iou = iou_matrix(anchors, gt_boxes)  # (A, G)
+    # snap(): fg/bg labeling is all discrete decisions (thresholds, per-gt
+    # best ties) on these IoUs; snapping makes them bit-identical across
+    # differently-partitioned compilations (see geometry.boxes.snap).
+    iou = snap(iou_matrix(anchors, gt_boxes))  # (A, G)
     iou = iou * gt_valid[None, :].astype(iou.dtype)
     max_iou = jnp.max(iou, axis=1)
     argmax_gt = jnp.argmax(iou, axis=1)
@@ -139,6 +144,8 @@ def assign_anchors(
     any_gt = jnp.any(gt_valid)
     iou_inside = iou * inside[:, None].astype(iou.dtype)
     gt_best = jnp.max(iou_inside, axis=0)  # (G,)
+    # Exact == is safe here because the IoUs are snapped to a coarse grid:
+    # ties are true ties in every compilation of this graph.
     is_gt_best = jnp.any(
         (iou_inside == gt_best[None, :]) & gt_valid[None, :] & (gt_best[None, :] > 0.0),
         axis=1,
@@ -213,7 +220,12 @@ def sample_rois(
     all_rois = jnp.concatenate([rois, gt_boxes], axis=0)  # (R+G, 4)
     all_valid = jnp.concatenate([roi_valid, gt_valid], axis=0)
 
-    iou = iou_matrix(all_rois, gt_boxes) * gt_valid[None, :].astype(rois.dtype)
+    # snap(): fg/bg thresholds and argmax matching below are discrete — keep
+    # them bit-stable across compilations (see geometry.boxes.snap).  bits=8
+    # (IoU grid ~0.004, invisible next to the 0.5/0.3 thresholds): the rois
+    # here are network outputs, so per-program contraction noise is broader
+    # than for constant anchor grids and needs the wider midpoint margin.
+    iou = snap(iou_matrix(all_rois, gt_boxes), bits=8) * gt_valid[None, :].astype(rois.dtype)
     max_iou = jnp.where(all_valid, jnp.max(iou, axis=1), -1.0)
     argmax_gt = jnp.argmax(iou, axis=1)
 

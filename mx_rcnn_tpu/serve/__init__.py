@@ -1,0 +1,44 @@
+"""Serving-grade inference runtime (docs/serving.md).
+
+``InferenceEngine`` wraps the jitted inference step with warmup
+compilation over fixed shape buckets, bounded-queue admission control,
+per-request deadlines, a degradation ladder, a circuit breaker, and a
+hang watchdog; ``EngineHealth`` exposes the readiness/liveness state
+machine and stats snapshot.
+"""
+
+from mx_rcnn_tpu.serve.degrade import (
+    LEVELS,
+    CircuitBreaker,
+    LatencyEstimator,
+    plan_level,
+)
+from mx_rcnn_tpu.serve.engine import (
+    DeadlineExceeded,
+    DetectorRunner,
+    EngineUnavailable,
+    InferenceEngine,
+    InferenceRequest,
+    Overloaded,
+    Plan,
+    ServeError,
+    build_engine,
+)
+from mx_rcnn_tpu.serve.health import EngineHealth
+
+__all__ = [
+    "LEVELS",
+    "CircuitBreaker",
+    "LatencyEstimator",
+    "plan_level",
+    "DeadlineExceeded",
+    "DetectorRunner",
+    "EngineUnavailable",
+    "InferenceEngine",
+    "InferenceRequest",
+    "Overloaded",
+    "Plan",
+    "ServeError",
+    "build_engine",
+    "EngineHealth",
+]

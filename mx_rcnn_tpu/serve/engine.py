@@ -1,0 +1,690 @@
+"""Robust inference runtime around the jitted inference step.
+
+The jitted graphs (detection/graph.py) are fast but brittle to operate:
+an unexpected image shape silently triggers a multi-second recompile, a
+hung device call blocks forever, and a burst of requests queues without
+bound.  :class:`InferenceEngine` wraps them with the serving behaviors a
+production endpoint needs:
+
+* **Startup warmup** — every (mode, resolution-bucket) program is
+  compiled before the engine reports ready; a request can never pay a
+  compile.
+* **Bucketed pad-batching** — requests letterbox into a fixed set of
+  resolution buckets and pad into static batch shapes, so arbitrary
+  request sizes never create new programs (enforced, not hoped:
+  :class:`DetectorRunner` refuses shapes outside the warmed set).
+* **Admission control** — a bounded queue; when it is full the request
+  is shed immediately with a typed :class:`Overloaded` instead of
+  queueing into certain deadline death.
+* **Per-request deadlines** — expired requests fail fast with
+  :class:`DeadlineExceeded`; remaining budget drives the degradation
+  ladder (serve/degrade.py) so tight deadlines get a cheaper program
+  instead of a guaranteed miss.
+* **Watchdog** — a monitor thread detects a device call that stopped
+  returning (hung runtime, wedged tunnel) and fails the engine to DEAD
+  so supervisors replace the process instead of black-holing traffic.
+
+The engine is generic over a ``runner`` (anything with ``buckets``,
+``levels()``, ``batch_size``, ``pick_bucket`` and ``run``); the real
+JAX-backed implementation is :class:`DetectorRunner`, and tests drive the
+same engine with deterministic fakes.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from mx_rcnn_tpu.serve import health as health_mod
+from mx_rcnn_tpu.serve.degrade import (
+    FULL_QUALITY_LEVELS,
+    CircuitBreaker,
+    LatencyEstimator,
+    plan_level,
+)
+
+log = logging.getLogger("mx_rcnn_tpu.serve")
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving failures."""
+
+
+class Overloaded(ServeError):
+    """Admission control shed this request: the queue is full."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before a result was produced."""
+
+
+class EngineUnavailable(ServeError):
+    """The engine cannot serve (not started, stopped, or declared dead)."""
+
+
+class Plan(NamedTuple):
+    level: str              # degrade.LEVELS entry
+    mode: str               # program family: full | reduced | proposals
+    bucket: tuple[int, int]  # compiled canvas (H, W)
+
+
+class InferenceRequest:
+    """A submitted request; ``result()`` blocks until served or failed."""
+
+    __slots__ = ("image", "enqueued_at", "deadline", "_event", "_result",
+                 "_error", "plan")
+
+    def __init__(self, image: np.ndarray, enqueued_at: float,
+                 deadline: Optional[float]) -> None:
+        self.image = image
+        self.enqueued_at = enqueued_at
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._result: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+        self.plan: Optional[Plan] = None
+
+    def _set_result(self, result: dict) -> None:
+        self._result = result
+        self._event.set()
+
+    def _set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """The served detections dict (boxes/scores/classes/level/...);
+        raises the typed serving error on failure.  The watchdog bounds
+        how long an un-timed wait can last."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not complete")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class DetectorRunner:
+    """JAX-backed runner: compiled programs over fixed shape buckets.
+
+    Programs (all compiled at warmup, none ever added after):
+      * ``("full", bucket)`` for EVERY bucket — the production detector.
+      * ``("reduced", smallest bucket)`` — ``reduced_max_detections``
+        output slots (cheaper postprocess/NMS).
+      * ``("proposals", smallest bucket)`` — RPN-only, class-agnostic.
+
+    ``run`` letterboxes each request image into the plan's bucket, pads
+    the micro-batch to the static ``batch_size``, executes, and maps
+    boxes back to original image coordinates.  Any (mode, bucket) pair
+    outside the warmed set is a hard error — the no-recompile guarantee
+    is enforced here rather than discovered in a latency graph.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        variables,
+        buckets: Optional[Sequence[tuple[int, int]]] = None,
+        batch_size: int = 1,
+        reduced_max_detections: Optional[int] = None,
+        with_proposals: bool = True,
+    ) -> None:
+        import dataclasses
+
+        import jax
+
+        from mx_rcnn_tpu.detection import TwoStageDetector
+
+        self.cfg = cfg
+        self.batch_size = int(batch_size)
+        bks = list(buckets) if buckets else [tuple(cfg.data.image_size)]
+        # Ascending by area; pick_bucket takes the first that fits.
+        self.buckets = sorted(
+            (tuple(int(x) for x in b) for b in bks),
+            key=lambda b: (b[0] * b[1], b),
+        )
+        if reduced_max_detections is None:
+            reduced_max_detections = max(1, cfg.model.test.max_detections // 4)
+        self.reduced_max_detections = int(reduced_max_detections)
+        stats = (cfg.data.pixel_mean, cfg.data.pixel_std)
+
+        model = TwoStageDetector(cfg=cfg.model)
+        reduced_cfg = dataclasses.replace(
+            cfg.model,
+            test=dataclasses.replace(
+                cfg.model.test,
+                max_detections=self.reduced_max_detections,
+                fused_top_k=min(
+                    cfg.model.test.fused_top_k,
+                    4 * self.reduced_max_detections,
+                ),
+            ),
+        )
+        reduced_model = TwoStageDetector(cfg=reduced_cfg)
+        self._variables = jax.device_put(variables)
+
+        from mx_rcnn_tpu.detection.graph import (
+            forward_inference,
+            forward_proposals,
+        )
+
+        # One jitted callable per MODE; buckets become distinct XLA
+        # programs of the same callable (different static shapes).
+        self._steps = {
+            "full": jax.jit(
+                lambda v, b: forward_inference(model, v, b, pixel_stats=stats)
+            ),
+            "reduced": jax.jit(
+                lambda v, b: forward_inference(
+                    reduced_model, v, b, pixel_stats=stats
+                )
+            ),
+            "proposals": jax.jit(
+                lambda v, b: forward_proposals(model, v, b, pixel_stats=stats)
+            ),
+        }
+        self._program_keys = [("full", b) for b in self.buckets]
+        if with_proposals:
+            self._program_keys += [
+                ("reduced", self.buckets[0]),
+                ("proposals", self.buckets[0]),
+            ]
+        else:
+            self._program_keys += [("reduced", self.buckets[0])]
+        self._warmed: set[tuple[str, tuple[int, int]]] = set()
+
+    # -- engine-facing surface --------------------------------------------
+
+    def levels(self) -> tuple[str, ...]:
+        out = ["full"]
+        if len(self.buckets) > 1:
+            out.append("small")
+        out.append("reduced")
+        if any(m == "proposals" for m, _ in self._program_keys):
+            out.append("proposals")
+        return tuple(out)
+
+    def pick_bucket(self, height: int, width: int) -> tuple[int, int]:
+        """Smallest bucket that holds the image without downscaling; the
+        largest bucket otherwise (letterbox downscales into it)."""
+        for b in self.buckets:
+            if b[0] >= height and b[1] >= width:
+                return b
+        return self.buckets[-1]
+
+    def smaller_bucket(
+        self, bucket: tuple[int, int]
+    ) -> Optional[tuple[int, int]]:
+        i = self.buckets.index(bucket)
+        return self.buckets[i - 1] if i > 0 else None
+
+    def warmup(self) -> int:
+        """Compile every program with a zero batch; returns program count."""
+        for mode, bucket in self._program_keys:
+            batch = self._make_batch(
+                np.zeros((self.batch_size, *bucket, 3), np.float32),
+                np.tile(
+                    np.asarray([bucket], np.float32), (self.batch_size, 1)
+                ),
+            )
+            out = self._steps[mode](self._variables, batch)
+            import jax
+
+            jax.block_until_ready(out)
+            self._warmed.add((mode, bucket))
+        return len(self._warmed)
+
+    def run(self, mode: str, bucket: tuple[int, int],
+            images: Sequence[np.ndarray]) -> list[dict]:
+        if (mode, bucket) not in self._warmed:
+            raise EngineUnavailable(
+                f"program ({mode}, {bucket}) was never warmed — refusing "
+                "to compile on the serving path"
+            )
+        if len(images) > self.batch_size:
+            raise ValueError(
+                f"micro-batch of {len(images)} exceeds batch_size "
+                f"{self.batch_size}"
+            )
+        import jax
+
+        from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
+
+        rows, hw, scales, orig = [], [], [], []
+        for img in images:
+            h, w = img.shape[:2]
+            canvas, _, scale, (nh, nw) = letterbox(
+                img.astype(np.float32),
+                np.zeros((0, 4), np.float32),
+                bucket,
+                min(bucket),
+                max(bucket),
+            )
+            rows.append(
+                normalize_image(
+                    canvas, self.cfg.data.pixel_mean, self.cfg.data.pixel_std
+                )
+            )
+            hw.append([nh, nw])
+            scales.append(scale)
+            orig.append((h, w))
+        pad = self.batch_size - len(rows)
+        if pad:
+            rows += [np.zeros_like(rows[0])] * pad
+            hw += [list(bucket)] * pad
+        batch = self._make_batch(
+            np.stack(rows), np.asarray(hw, np.float32)
+        )
+        out = jax.device_get(self._steps[mode](self._variables, batch))
+        return [
+            self._postprocess(mode, out, i, scales[i], *orig[i])
+            for i in range(len(images))
+        ]
+
+    # -- internals ---------------------------------------------------------
+
+    def _make_batch(self, images: np.ndarray, image_hw: np.ndarray):
+        from mx_rcnn_tpu.detection import Batch
+
+        g = self.cfg.data.max_gt_boxes
+        b = images.shape[0]
+        return Batch(
+            images=images,
+            image_hw=image_hw,
+            gt_boxes=np.zeros((b, g, 4), np.float32),
+            gt_classes=np.zeros((b, g), np.int32),
+            gt_valid=np.zeros((b, g), bool),
+        )
+
+    def _postprocess(self, mode, out, i, scale, height, width) -> dict:
+        from mx_rcnn_tpu.evalutil.postprocess import unletterbox_detections
+
+        if mode == "proposals":
+            valid = np.asarray(out.valid[i])
+            boxes = np.asarray(out.rois[i])[valid] / max(scale, 1e-12)
+            boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, width - 1)
+            boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, height - 1)
+            return {
+                "boxes": boxes.astype(np.float32),
+                "scores": np.asarray(out.scores[i])[valid],
+                "classes": np.zeros(int(valid.sum()), np.int32),
+            }
+        return unletterbox_detections(
+            out.boxes[i], out.scores[i], out.classes[i], out.valid[i],
+            scale, height, width,
+            masks=out.masks[i] if getattr(out, "masks", None) is not None
+            else None,
+        )
+
+
+class InferenceEngine:
+    """Bounded-queue serving loop over a runner's compiled programs.
+
+    Lifecycle: construct → ``start()`` (warms every program, then spawns
+    the worker + watchdog threads and reports READY) → ``submit``/
+    ``infer`` → ``stop()``.  Usable as a context manager.
+    """
+
+    _STOP = object()
+
+    def __init__(
+        self,
+        runner,
+        max_queue: int = 16,
+        default_timeout: Optional[float] = None,
+        hang_timeout: float = 60.0,
+        watchdog_poll: float = 0.25,
+        headroom: float = 1.25,
+        breaker: Optional[CircuitBreaker] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.runner = runner
+        self._clock = clock
+        self.default_timeout = default_timeout
+        self.hang_timeout = hang_timeout
+        self.watchdog_poll = watchdog_poll
+        self.headroom = headroom
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.estimates = LatencyEstimator()
+        self.health = health_mod.EngineHealth(clock=clock)
+        self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=max_queue)
+        self._carry: Optional[InferenceRequest] = None
+        self._inflight_since: Optional[float] = None
+        self._inflight_plan: Optional[Plan] = None
+        self._inflight_reqs: list[InferenceRequest] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self._stopping = False
+        self._worker: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        if self._started:
+            return self
+        try:
+            n = self.runner.warmup()
+        except Exception as e:
+            self.health.transition(
+                health_mod.DEAD, f"warmup failed: {type(e).__name__}: {e}"
+            )
+            raise
+        log.info(
+            "engine ready: %d compiled programs, buckets=%s, levels=%s",
+            n, list(self.runner.buckets), list(self.runner.levels()),
+        )
+        self._started = True
+        self.health.transition(health_mod.READY, "warmup complete")
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="serve-worker", daemon=True
+        )
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="serve-watchdog", daemon=True
+        )
+        self._worker.start()
+        self._watchdog.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if not self._started or self._stopping:
+            return
+        self._stopping = True
+        try:
+            self._queue.put_nowait(self._STOP)
+        except queue_mod.Full:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout)
+        self._fail_pending(EngineUnavailable("engine stopped"))
+        self.health.transition(health_mod.DEAD, "stopped")
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API --------------------------------------------------------
+
+    def submit(
+        self, image: np.ndarray, timeout: Optional[float] = None
+    ) -> InferenceRequest:
+        """Enqueue one image; returns immediately.  Raises
+        :class:`Overloaded` when the queue is full, or
+        :class:`EngineUnavailable` when the engine cannot serve."""
+        if not self._started or self._stopping:
+            raise EngineUnavailable("engine not started")
+        if not self.health.alive():
+            raise EngineUnavailable(
+                f"engine is dead: {self.health.reason}"
+            )
+        now = self._clock()
+        timeout = self.default_timeout if timeout is None else timeout
+        req = InferenceRequest(
+            image, now, None if timeout is None else now + timeout
+        )
+        try:
+            self._queue.put_nowait(req)
+        except queue_mod.Full:
+            self.health.record_shed()
+            self._note_pressure()
+            raise Overloaded(
+                f"queue full ({self._queue.maxsize} waiting); request shed"
+            ) from None
+        return req
+
+    def infer(
+        self, image: np.ndarray, timeout: Optional[float] = None
+    ) -> dict:
+        return self.submit(image, timeout).result()
+
+    def stats(self) -> dict:
+        with self._lock:
+            inflight_age = (
+                None
+                if self._inflight_since is None
+                else round(self._clock() - self._inflight_since, 3)
+            )
+        return self.health.snapshot(
+            queue_depth=self._queue.qsize(),
+            inflight_age_s=inflight_age,
+            breaker=self.breaker.state,
+            breaker_trips=self.breaker.trips,
+            latency_estimates_s=self.estimates.snapshot(),
+            buckets=[list(b) for b in self.runner.buckets],
+        )
+
+    # -- planning ----------------------------------------------------------
+
+    def _plan(self, req: InferenceRequest) -> Plan:
+        h, w = req.image.shape[:2]
+        base = self.runner.pick_bucket(h, w)
+        smaller = self.runner.smaller_bucket(base)
+        available = [
+            lvl for lvl in self.runner.levels()
+            if lvl != "small" or smaller is not None
+        ]
+        remaining = (
+            None if req.deadline is None else req.deadline - self._clock()
+        )
+        full_ok = self.breaker.allow_full()
+        level = plan_level(
+            remaining, self.estimates.snapshot(), full_ok, available,
+            headroom=self.headroom,
+        )
+        if full_ok and level not in FULL_QUALITY_LEVELS:
+            # Consumed a half-open probe but was forced to degrade anyway
+            # (deadline pressure) — return it, this is not a probe outcome.
+            self.breaker.cancel_probe()
+        if level == "full":
+            return Plan("full", "full", base)
+        if level == "small":
+            assert smaller is not None
+            return Plan("small", "full", smaller)
+        # reduced / proposals programs exist for the smallest bucket only.
+        return Plan(level, level, self.runner.buckets[0])
+
+    def _note_pressure(self) -> None:
+        if self.health.state == health_mod.READY:
+            self.health.transition(health_mod.DEGRADED, "load shedding")
+
+    # -- worker ------------------------------------------------------------
+
+    def _take_batch(self) -> Optional[list[InferenceRequest]]:
+        """Next micro-batch: the first live request plus any immediately
+        available requests with the SAME plan, up to the static batch."""
+        while True:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue_mod.Empty:
+                    return None
+            if first is self._STOP:
+                return []
+            if (
+                first.deadline is not None
+                and self._clock() > first.deadline
+            ):
+                self.health.record_deadline_miss()
+                self._note_pressure()
+                first._set_error(
+                    DeadlineExceeded("deadline passed while queued")
+                )
+                continue
+            first.plan = self._plan(first)
+            batch = [first]
+            while len(batch) < self.runner.batch_size:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is self._STOP:
+                    self._stopping = True
+                    break
+                if (
+                    nxt.deadline is not None
+                    and self._clock() > nxt.deadline
+                ):
+                    self.health.record_deadline_miss()
+                    nxt._set_error(
+                        DeadlineExceeded("deadline passed while queued")
+                    )
+                    continue
+                nxt.plan = self._plan(nxt)
+                if nxt.plan[1:] != first.plan[1:]:
+                    self._carry = nxt  # different program; runs next
+                    break
+                batch.append(nxt)
+            return batch
+
+    def _worker_loop(self) -> None:
+        while not self._stopping:
+            batch = self._take_batch()
+            if batch is None:
+                continue
+            if not batch:  # STOP
+                break
+            plan = batch[0].plan
+            assert plan is not None
+            start = self._clock()
+            with self._lock:
+                self._inflight_since = start
+                self._inflight_plan = plan
+                self._inflight_reqs = list(batch)
+            try:
+                results = self.runner.run(
+                    plan.mode, plan.bucket, [r.image for r in batch]
+                )
+                err: Optional[BaseException] = None
+            except BaseException as e:  # noqa: BLE001 - typed below
+                results, err = None, e
+            finally:
+                with self._lock:
+                    self._inflight_since = None
+                    self._inflight_plan = None
+                    self._inflight_reqs = []
+            if not self.health.alive():
+                # The watchdog declared us dead while this call was stuck;
+                # its requests were already failed.  Drop the zombie result.
+                break
+            latency = self._clock() - start
+            if err is not None:
+                self.health.record_failure()
+                if plan.level in FULL_QUALITY_LEVELS:
+                    self.breaker.record_failure()
+                self._note_pressure()
+                for r in batch:
+                    r._set_error(
+                        ServeError(
+                            f"inference failed at level {plan.level}: "
+                            f"{type(err).__name__}: {err}"
+                        )
+                    )
+                continue
+            self.estimates.observe(plan.level, latency)
+            late = [
+                r for r in batch
+                if r.deadline is not None and self._clock() > r.deadline
+            ]
+            if plan.level in FULL_QUALITY_LEVELS:
+                # A full-path overrun that blew the deadline counts against
+                # the breaker; an on-time full result heals it.
+                if late:
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()
+            for r, res in zip(batch, results):
+                if r in late:
+                    self.health.record_deadline_miss()
+                    self._note_pressure()
+                    r._set_error(
+                        DeadlineExceeded(
+                            f"served at level {plan.level} in "
+                            f"{latency:.3f}s, past the deadline"
+                        )
+                    )
+                else:
+                    self.health.record_served(plan.level, latency)
+                    res = dict(res)
+                    res["level"] = plan.level
+                    res["latency_s"] = latency
+                    r._set_result(res)
+            if (
+                self.health.state == health_mod.DEGRADED
+                and self.breaker.state == "closed"
+                and not late
+                and self._queue.qsize() < max(1, self._queue.maxsize // 2)
+            ):
+                self.health.transition(health_mod.READY, "pressure cleared")
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _fail_pending(self, error: BaseException) -> None:
+        if self._carry is not None:
+            self._carry._set_error(error)
+            self._carry = None
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            if item is not self._STOP:
+                item._set_error(error)
+
+    def _watchdog_loop(self) -> None:
+        while not self._stopping and self.health.alive():
+            time.sleep(self.watchdog_poll)
+            with self._lock:
+                since = self._inflight_since
+                plan = self._inflight_plan
+            if since is None:
+                continue
+            age = self._clock() - since
+            if age <= self.hang_timeout:
+                continue
+            self.health.hung += 1
+            self.health.transition(
+                health_mod.DEAD,
+                f"device call hung for {age:.1f}s "
+                f"(plan={plan}, hang_timeout={self.hang_timeout}s)",
+            )
+            log.error(
+                "watchdog: %s — failing %d queued request(s)",
+                self.health.reason, self._queue.qsize(),
+            )
+            error = EngineUnavailable(f"engine died: {self.health.reason}")
+            with self._lock:
+                stuck = list(self._inflight_reqs)
+            for r in stuck:
+                # The device call may never return; unblock its waiters.
+                r._set_error(error)
+            self._fail_pending(error)
+            return
+
+
+def build_engine(
+    cfg,
+    variables,
+    buckets: Optional[Sequence[tuple[int, int]]] = None,
+    batch_size: int = 1,
+    **engine_kwargs,
+) -> InferenceEngine:
+    """Convenience: real runner + engine from a config and variables
+    (checkpoint-restored or freshly initialized)."""
+    runner = DetectorRunner(
+        cfg, variables, buckets=buckets, batch_size=batch_size
+    )
+    return InferenceEngine(runner, **engine_kwargs)

@@ -1,0 +1,523 @@
+"""Serving runtime tests (docs/serving.md).
+
+Fast tests drive ``InferenceEngine`` with a fake runner — admission
+control, deadline handling, the degradation ladder, the circuit breaker,
+and the hang watchdog are all thread/policy logic that needs no model.
+The compile-count test is the serving contract in miniature: after
+warmup, arbitrary request sizes must never reach an unwarmed (=would
+recompile) program.  Sharded resumable evaluation is proven byte-exact
+with a real loader and a fake eval step; ``tools/chaos.py`` repeats the
+story against real subprocesses with real signals.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import get_config
+from mx_rcnn_tpu.serve import (
+    LEVELS,
+    CircuitBreaker,
+    DeadlineExceeded,
+    EngineHealth,
+    EngineUnavailable,
+    InferenceEngine,
+    Overloaded,
+    plan_level,
+)
+from mx_rcnn_tpu.serve import health as health_mod
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# degrade policy (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanLevel:
+    AVAIL = ("full", "small", "reduced", "proposals")
+
+    def test_no_deadline_no_estimates_is_full(self):
+        assert plan_level(None, {}, True, self.AVAIL) == "full"
+
+    def test_ladder_order_is_quality_order(self):
+        # Estimates that each just miss the deadline peel levels off in
+        # LEVELS order — the ladder never jumps past a level.
+        est = {"full": 10.0, "small": 5.0, "reduced": 1.0, "proposals": 0.1}
+        assert plan_level(100.0, est, True, self.AVAIL) == "full"
+        assert plan_level(8.0, est, True, self.AVAIL) == "small"
+        assert plan_level(2.0, est, True, self.AVAIL) == "reduced"
+        assert plan_level(0.2, est, True, self.AVAIL) == "proposals"
+
+    def test_nothing_fits_returns_cheapest(self):
+        est = {lvl: 10.0 for lvl in LEVELS}
+        assert plan_level(0.01, est, True, self.AVAIL) == "proposals"
+
+    def test_unestimated_level_assumed_to_fit(self):
+        est = {"full": 10.0}
+        assert plan_level(1.0, est, True, self.AVAIL) == "small"
+
+    def test_breaker_open_skips_full_quality(self):
+        assert plan_level(None, {}, False, self.AVAIL) == "reduced"
+
+    def test_breaker_open_with_only_full_still_serves(self):
+        assert plan_level(None, {}, False, ("full",)) == "full"
+
+    def test_headroom_margin(self):
+        est = {"full": 1.0}
+        assert plan_level(1.1, est, True, self.AVAIL, headroom=1.25) == "small"
+        assert plan_level(1.3, est, True, self.AVAIL, headroom=1.25) == "full"
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_consecutive(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=3, cooldown=5.0, clock=clk)
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        assert b.trips == 1
+        assert not b.allow_full()
+
+    def test_success_resets_consecutive_count(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=2, clock=clk)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_probe_lifecycle(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, cooldown=5.0, clock=clk)
+        b.record_failure()
+        assert b.state == "open"
+        clk.advance(5.0)
+        assert b.state == "half_open"
+        assert b.allow_full()  # consumes THE probe
+        assert not b.allow_full()  # second caller is refused
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_failed_probe_reopens(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, cooldown=5.0, clock=clk)
+        b.record_failure()
+        clk.advance(5.0)
+        assert b.allow_full()
+        b.record_failure()
+        assert b.state == "open"
+        assert b.trips == 2
+
+    def test_cancel_probe_returns_slot(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, cooldown=5.0, clock=clk)
+        b.record_failure()
+        clk.advance(5.0)
+        assert b.allow_full()
+        b.cancel_probe()
+        assert b.allow_full()  # the slot is available again
+
+
+class TestHealth:
+    def test_legal_lifecycle(self):
+        h = EngineHealth()
+        assert h.state == health_mod.STARTING and not h.ready()
+        assert h.transition(health_mod.READY)
+        assert h.ready() and h.alive()
+        assert h.transition(health_mod.DEGRADED, "shedding")
+        assert h.ready()  # degraded still serves
+        assert h.transition(health_mod.READY)
+        assert h.transition(health_mod.DEAD, "hung")
+        assert not h.ready() and not h.alive()
+
+    def test_dead_is_absorbing(self):
+        h = EngineHealth()
+        h.transition(health_mod.READY)
+        h.transition(health_mod.DEAD)
+        assert not h.transition(health_mod.READY)
+        assert h.state == health_mod.DEAD
+
+    def test_illegal_jump_refused(self):
+        h = EngineHealth()
+        assert not h.transition(health_mod.DEGRADED)  # STARTING -> DEGRADED
+        assert h.state == health_mod.STARTING
+
+    def test_snapshot_counts(self):
+        h = EngineHealth()
+        h.transition(health_mod.READY)
+        h.record_served("full", 0.1)
+        h.record_served("reduced", 0.05)
+        h.record_shed()
+        s = h.snapshot(queue_depth=3)
+        assert s["served"] == {"full": 1, "reduced": 1}
+        assert s["served_total"] == 2
+        assert s["shed"] == 1
+        assert s["queue_depth"] == 3
+        assert s["ready"] and s["alive"]
+        json.dumps(s)  # dashboard contract: JSON-able
+
+
+# ---------------------------------------------------------------------------
+# engine against a fake runner
+# ---------------------------------------------------------------------------
+
+
+def _det(n=0):
+    return {
+        "boxes": np.zeros((n, 4), np.float32),
+        "scores": np.zeros(n, np.float32),
+        "classes": np.zeros(n, np.int32),
+    }
+
+
+class FakeRunner:
+    """Runner-protocol fake: warmup registers the compiled program set;
+    ``run`` on anything outside it is the recompile bug the engine must
+    never trigger."""
+
+    def __init__(self, buckets=((64, 64), (128, 128)), batch_size=1,
+                 block: Optional[threading.Event] = None, fail_modes=()):
+        self.buckets = sorted(
+            (tuple(b) for b in buckets), key=lambda b: b[0] * b[1]
+        )
+        self.batch_size = batch_size
+        self.block = block
+        self.fail_modes = set(fail_modes)
+        self.compile_count = 0
+        self.run_calls = []
+        self._warmed = set()
+
+    def levels(self):
+        out = ["full"]
+        if len(self.buckets) > 1:
+            out.append("small")
+        out += ["reduced", "proposals"]
+        return tuple(out)
+
+    def pick_bucket(self, h, w):
+        for b in self.buckets:
+            if b[0] >= h and b[1] >= w:
+                return b
+        return self.buckets[-1]
+
+    def smaller_bucket(self, bucket):
+        i = self.buckets.index(bucket)
+        return self.buckets[i - 1] if i > 0 else None
+
+    def warmup(self):
+        keys = [("full", b) for b in self.buckets]
+        keys += [("reduced", self.buckets[0]), ("proposals", self.buckets[0])]
+        for k in keys:
+            if k not in self._warmed:
+                self.compile_count += 1
+                self._warmed.add(k)
+        return len(self._warmed)
+
+    def run(self, mode, bucket, images):
+        key = (mode, bucket)
+        assert key in self._warmed, f"RECOMPILATION on serving path: {key}"
+        self.run_calls.append((mode, bucket, len(images)))
+        if self.block is not None:
+            self.block.wait()
+        if mode in self.fail_modes:
+            raise RuntimeError("injected device failure")
+        return [_det() for _ in images]
+
+
+def _img(h, w):
+    return np.zeros((h, w, 3), np.float32)
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError("timed out waiting for condition")
+        time.sleep(0.005)
+
+
+class TestEngine:
+    def test_no_recompile_for_arbitrary_request_sizes(self):
+        runner = FakeRunner()
+        with InferenceEngine(runner) as e:
+            warm_compiles = runner.compile_count
+            # Sizes straddling both buckets, including one larger than the
+            # largest bucket (letterboxes down) — none may compile.
+            for h, w in [(10, 10), (64, 64), (65, 64), (128, 128),
+                         (500, 300), (1, 777), (127, 3)]:
+                res = e.infer(_img(h, w))
+                assert res["level"] == "full"
+            assert runner.compile_count == warm_compiles
+        # FakeRunner.run asserts on unwarmed keys, so reaching here also
+        # proves every served program came from warmup.
+
+    def test_small_images_use_small_bucket_program(self):
+        runner = FakeRunner()
+        with InferenceEngine(runner) as e:
+            e.infer(_img(32, 32))
+        assert runner.run_calls[-1][1] == (64, 64)
+
+    def test_overload_sheds_deterministically(self):
+        gate = threading.Event()
+        runner = FakeRunner(block=gate)
+        e = InferenceEngine(runner, max_queue=2).start()
+        try:
+            first = e.submit(_img(8, 8))
+            # The worker has the first request (blocked in run) once the
+            # queue drains; the queue then holds exactly what we add.
+            _wait(lambda: e._queue.qsize() == 0 and runner.run_calls)
+            queued = [e.submit(_img(8, 8)) for _ in range(2)]
+            with pytest.raises(Overloaded):
+                e.submit(_img(8, 8))
+            assert e.stats()["shed"] == 1
+            assert e.stats()["state"] == health_mod.DEGRADED
+            gate.set()
+            for r in [first, *queued]:
+                assert r.result(timeout=5)["level"] == "full"  # no deadlock
+        finally:
+            gate.set()
+            e.stop()
+
+    def test_expired_queue_deadline_is_typed(self):
+        runner = FakeRunner()
+        with InferenceEngine(runner) as e:
+            req = e.submit(_img(8, 8), timeout=-1.0)
+            with pytest.raises(DeadlineExceeded):
+                req.result(timeout=5)
+            assert e.stats()["deadline_missed"] == 1
+
+    def test_open_breaker_serves_degraded(self):
+        breaker = CircuitBreaker(failure_threshold=1, cooldown=3600)
+        breaker.record_failure()
+        runner = FakeRunner()
+        with InferenceEngine(runner, breaker=breaker) as e:
+            res = e.infer(_img(8, 8))
+        assert res["level"] == "reduced"
+        assert runner.run_calls[-1][0] == "reduced"
+
+    def test_latency_pressure_walks_the_ladder(self):
+        runner = FakeRunner()
+        with InferenceEngine(runner) as e:
+            e.estimates.observe("full", 10.0)
+            e.estimates.observe("small", 10.0)
+            e.estimates.observe("reduced", 1e-4)
+            res = e.infer(_img(8, 8), timeout=0.5)
+        assert res["level"] == "reduced"
+
+    def test_device_failure_is_typed_and_trips_breaker(self):
+        runner = FakeRunner(fail_modes={"full"})
+        breaker = CircuitBreaker(failure_threshold=1, cooldown=3600)
+        with InferenceEngine(runner, breaker=breaker) as e:
+            from mx_rcnn_tpu.serve import ServeError
+
+            with pytest.raises(ServeError):
+                e.infer(_img(8, 8))
+            assert breaker.state == "open"
+            # Next request degrades instead of failing: the ladder works.
+            assert e.infer(_img(8, 8))["level"] == "reduced"
+
+    def test_watchdog_declares_hang_and_fails_waiters(self):
+        gate = threading.Event()  # never set while "hung"
+        runner = FakeRunner(block=gate)
+        e = InferenceEngine(
+            runner, hang_timeout=0.2, watchdog_poll=0.02
+        ).start()
+        try:
+            req = e.submit(_img(8, 8))
+            with pytest.raises(EngineUnavailable):
+                req.result(timeout=10)
+            assert e.stats()["hung"] == 1
+            assert e.stats()["state"] == health_mod.DEAD
+            with pytest.raises(EngineUnavailable):
+                e.submit(_img(8, 8))
+        finally:
+            gate.set()  # let the stuck worker thread exit
+            e.stop(timeout=2)
+
+    def test_stop_fails_pending_and_is_idempotent(self):
+        runner = FakeRunner()
+        e = InferenceEngine(runner).start()
+        e.stop()
+        e.stop()
+        with pytest.raises(EngineUnavailable):
+            e.submit(_img(8, 8))
+
+
+# ---------------------------------------------------------------------------
+# sharded resumable evaluation
+# ---------------------------------------------------------------------------
+
+
+class _FakeDets(NamedTuple):
+    boxes: np.ndarray
+    scores: np.ndarray
+    classes: np.ndarray
+    valid: np.ndarray
+    masks: type(None) = None
+
+
+def _fake_eval_step(variables, batch):
+    """Deterministic detections derived from the batch — no model, no jit."""
+    b = batch.images.shape[0]
+    hw = np.asarray(batch.image_hw, np.float64)
+    boxes = np.stack(
+        [np.array([[1.0, 2.0, h / 2, w / 2]], np.float64) for h, w in hw]
+    )
+    scores = (hw[:, :1] / (hw[:, :1] + 100.0)).astype(np.float64)
+    return _FakeDets(
+        boxes=boxes,
+        scores=scores,
+        classes=np.ones((b, 1), np.int64),
+        valid=np.ones((b, 1), bool),
+    )
+
+
+@pytest.fixture
+def tiny_loader():
+    from mx_rcnn_tpu.data import DetectionLoader, build_dataset
+
+    cfg = get_config("tiny_synthetic")
+    roidb = build_dataset(cfg.data, train=False).roidb()[:8]
+    return DetectionLoader(roidb, cfg.data, batch_size=2, train=False)
+
+
+class TestShardedEval:
+    def _run(self, loader, shard_dir, **kw):
+        from mx_rcnn_tpu.evalutil.pred_eval import (
+            collect_detections_sharded,
+            merge_detection_shards,
+        )
+
+        paths = collect_detections_sharded(
+            _fake_eval_step, None, loader, str(shard_dir), shard_size=1, **kw
+        )
+        out = str(shard_dir) + ".json"
+        merge_detection_shards(paths, out_path=out)
+        with open(out, "rb") as f:
+            return f.read()
+
+    def test_interrupted_resume_is_byte_identical(self, tiny_loader, tmp_path):
+        from mx_rcnn_tpu.evalutil.pred_eval import collect_detections_sharded
+        from mx_rcnn_tpu.train.preemption import Preempted
+
+        clean = self._run(tiny_loader, tmp_path / "clean")
+
+        state = {"done": 0}
+
+        class GuardStub:
+            @property
+            def triggered(self):
+                return state["done"] >= 2  # trip after the first shards
+
+        with pytest.raises(Preempted):
+            collect_detections_sharded(
+                _fake_eval_step, None, tiny_loader, str(tmp_path / "intr"),
+                shard_size=1, guard=GuardStub(),
+                progress=lambda n: state.update(done=n),
+            )
+        done = [
+            f for f in os.listdir(tmp_path / "intr") if f.startswith("shard-")
+        ]
+        assert 0 < len(done) < 4, "interruption must leave a partial run"
+        resumed = self._run(tiny_loader, tmp_path / "intr", resume=True)
+        assert resumed == clean
+
+    def test_resume_skips_completed_shards(self, tiny_loader, tmp_path):
+        calls = []
+
+        def counting_step(v, b):
+            calls.append(1)
+            return _fake_eval_step(v, b)
+
+        from mx_rcnn_tpu.evalutil.pred_eval import collect_detections_sharded
+
+        collect_detections_sharded(
+            counting_step, None, tiny_loader, str(tmp_path), shard_size=1
+        )
+        n_first = len(calls)
+        collect_detections_sharded(
+            counting_step, None, tiny_loader, str(tmp_path), shard_size=1,
+            resume=True,
+        )
+        assert len(calls) == n_first, "resume of a complete run re-ran work"
+
+    def test_schedule_change_refuses_resume(self, tiny_loader, tmp_path):
+        self._run(tiny_loader, tmp_path / "s")
+        from mx_rcnn_tpu.evalutil.pred_eval import collect_detections_sharded
+
+        with pytest.raises(ValueError, match="resume refused"):
+            collect_detections_sharded(
+                _fake_eval_step, None, tiny_loader, str(tmp_path / "s"),
+                shard_size=2, resume=True,
+            )
+
+    def test_shard_retry_bounded(self, tiny_loader, tmp_path):
+        from mx_rcnn_tpu.evalutil.pred_eval import collect_detections_sharded
+
+        attempts = []
+
+        def flaky_step(v, b):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise RuntimeError("transient")
+            return _fake_eval_step(v, b)
+
+        paths = collect_detections_sharded(
+            flaky_step, None, tiny_loader, str(tmp_path), shard_size=1,
+            max_retries=1,
+        )
+        assert all(os.path.exists(p) for p in paths)
+
+        def always_fails(v, b):
+            raise RuntimeError("permanent")
+
+        with pytest.raises(RuntimeError, match="permanent"):
+            collect_detections_sharded(
+                always_fails, None, tiny_loader, str(tmp_path / "f"),
+                shard_size=1, max_retries=2,
+            )
+
+
+# ---------------------------------------------------------------------------
+# demo CLI input handling
+# ---------------------------------------------------------------------------
+
+
+class TestDemoInput:
+    def test_missing_file_clean_exit(self):
+        from mx_rcnn_tpu.cli.demo_cli import load_demo_image
+
+        with pytest.raises(SystemExit, match="not found"):
+            load_demo_image("/nonexistent/image.png")
+
+    def test_corrupt_file_clean_exit(self, tmp_path):
+        from mx_rcnn_tpu.cli.demo_cli import load_demo_image
+
+        bad = tmp_path / "bad.png"
+        bad.write_bytes(b"definitely not a png")
+        with pytest.raises(SystemExit, match="not a decodable image"):
+            load_demo_image(str(bad))
+
+    def test_resume_flag_requires_resumable(self):
+        from mx_rcnn_tpu.cli.eval_cli import main
+
+        with pytest.raises(SystemExit, match="--resume requires"):
+            main(["--config", "tiny_synthetic", "--resume"])

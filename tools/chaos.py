@@ -21,17 +21,33 @@ faults the runtime is supposed to survive:
             fall back to the previous step and STILL converge to
             baseline's exact params.
 
+Inference scenarios (docs/serving.md) — same real-subprocess discipline:
+
+  eval_sigkill  SIGKILL a --resumable eval once shard checkpoints are on
+                disk; re-run with --resume; the final detections JSON
+                must be BYTE-IDENTICAL to an uninterrupted eval's.
+  eval_corrupt  poison images via MX_RCNN_CHAOS_BAD_IMAGES; eval must
+                finish cleanly, quarantine the ids, and still dump every
+                scheduled image.
+  overload      flood a real engine past its bounded queue; at least one
+                request must be shed (typed Overloaded) and every
+                admitted request must complete — no deadlock.
+  hang          serve through a runner whose device call never returns;
+                the watchdog must declare the engine dead and fail the
+                waiter with a typed error instead of hanging the client.
+
 Bit-identity holds because recovery re-runs the same compiled program
 over the same data schedule from the same restored state — it is the
 strongest possible "nothing was lost, nothing was double-applied" check
 and it needs no tolerance tuning.
 
 Usage:
-  python tools/chaos.py [--scenario all|baseline|sigkill|sigterm|nan|truncate]
+  python tools/chaos.py [--scenario all|baseline|sigkill|sigterm|nan|truncate
+                                    |eval_sigkill|eval_corrupt|overload|hang]
                         [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
 
 Prints one JSON summary line on stdout; exits non-zero if any scenario
-fails.  (`--child` / `--compare` are internal subprocess entry modes.)
+fails.  (`--child*` / `--compare` are internal subprocess entry modes.)
 """
 
 from __future__ import annotations
@@ -50,6 +66,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONFIG = "tiny_synthetic"
 CKPT_EVERY = 3
 LOG_EVERY = 2
+EVAL_LIMIT = 16  # images per chaos eval (shard_size=1 -> one shard each)
 
 
 def _hermetic_cpu() -> None:
@@ -80,6 +97,108 @@ def child_main(argv: list[str]) -> int:
     from mx_rcnn_tpu.cli import train_cli
 
     return train_cli.cli(argv)
+
+
+def child_eval_main(argv: list[str]) -> int:
+    """Run the real eval CLI hermetically (resumable-eval scenarios)."""
+    _hermetic_cpu()
+    from mx_rcnn_tpu.cli import eval_cli
+
+    return eval_cli.cli(argv)
+
+
+def child_overload_main() -> int:
+    """Flood a REAL engine (tiny model, random params) past its queue.
+
+    Prints one JSON line: submitted/shed/served counts and engine stats.
+    Exits 0 only if >=1 request was shed AND every admitted request
+    completed — returning at all is the no-deadlock proof."""
+    _hermetic_cpu()
+    import numpy as np
+
+    import jax
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+    from mx_rcnn_tpu.serve import Overloaded, build_engine
+
+    cfg = get_config(CONFIG)
+    variables = init_detector(
+        TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
+        cfg.data.image_size,
+    )
+    img = np.random.default_rng(0).uniform(
+        0, 255, (100, 100, 3)
+    ).astype(np.float32)
+    submitted = 12
+    shed = 0
+    reqs = []
+    with build_engine(cfg, variables, max_queue=2) as engine:
+        # The burst is orders of magnitude faster than one device call, so
+        # the 2-deep queue must overflow deterministically.
+        for _ in range(submitted):
+            try:
+                reqs.append(engine.submit(img))
+            except Overloaded:
+                shed += 1
+        served = sum(1 for r in reqs if r.result(timeout=300))
+        stats = engine.stats()
+    print(json.dumps({
+        "submitted": submitted, "shed": shed, "served": served,
+        "stats_shed": stats["shed"], "state": stats["state"],
+    }))
+    assert shed >= 1, "queue never overflowed — admission control untested"
+    assert served == submitted - shed, "admitted request lost (deadlock?)"
+    assert stats["shed"] == shed
+    return 0
+
+
+def child_hang_main() -> int:
+    """Serve through a runner whose device call never returns; the
+    watchdog must fail the waiter and declare the engine dead."""
+    _hermetic_cpu()
+    import threading
+
+    import numpy as np
+    from mx_rcnn_tpu.serve import EngineUnavailable, InferenceEngine
+
+    class HangingRunner:
+        """Runner-protocol stub wedged like a hung device stream."""
+
+        buckets = [(64, 64)]
+        batch_size = 1
+
+        def levels(self):
+            return ("full", "reduced")
+
+        def pick_bucket(self, h, w):
+            return (64, 64)
+
+        def smaller_bucket(self, bucket):
+            return None
+
+        def warmup(self):
+            return 1
+
+        def run(self, mode, bucket, images):
+            threading.Event().wait()  # never returns
+
+    engine = InferenceEngine(
+        HangingRunner(), hang_timeout=1.0, watchdog_poll=0.1
+    ).start()
+    req = engine.submit(np.zeros((32, 32, 3), np.float32))
+    try:
+        req.result(timeout=60)
+        print(json.dumps({"ok": False, "why": "hung request returned"}))
+        return 1
+    except EngineUnavailable:
+        pass
+    stats = engine.stats()
+    print(json.dumps({"hung": stats["hung"], "state": stats["state"]}))
+    assert stats["hung"] == 1, stats
+    assert stats["state"] == "dead", stats
+    # No engine.stop(): the worker daemon thread is wedged by design and
+    # must not block process exit.
+    return 0
 
 
 def compare_main(dir_a: str, dir_b: str) -> int:
@@ -120,6 +239,18 @@ def train_argv(workdir: str, steps: int, resume: bool = False) -> list[str]:
     return argv
 
 
+def eval_argv(workdir: str, ckpt: str, resume: bool = False) -> list[str]:
+    argv = [
+        sys.executable, os.path.abspath(__file__), "--child-eval", "--",
+        "--config", CONFIG, "--workdir", workdir, "--ckpt", ckpt,
+        "--resumable", "--shard-size", "1", "--limit", str(EVAL_LIMIT),
+        "--dump", os.path.join(workdir, "detections.json"),
+    ]
+    if resume:
+        argv.append("--resume")
+    return argv
+
+
 def ckpt_dir(workdir: str) -> str:
     return os.path.join(workdir, CONFIG, "ckpt")
 
@@ -150,15 +281,14 @@ def metrics_rows(workdir: str) -> list[dict]:
 
 
 class Child:
-    def __init__(self, workdir: str, steps: int, resume: bool = False,
+    def __init__(self, workdir: str, argv: list[str],
+                 log_name: str = "child-first",
                  env: dict | None = None) -> None:
-        self.log_path = os.path.join(
-            workdir, f"child-{'resume' if resume else 'first'}.log"
-        )
+        self.log_path = os.path.join(workdir, f"{log_name}.log")
         os.makedirs(workdir, exist_ok=True)
         self._log = open(self.log_path, "a")
         self.proc = subprocess.Popen(
-            train_argv(workdir, steps, resume),
+            argv,
             stdout=self._log, stderr=subprocess.STDOUT,
             env={**os.environ, **(env or {})}, cwd=REPO_ROOT,
         )
@@ -187,15 +317,23 @@ def wait_for(predicate, timeout: float, poll: float = 0.25):
     return None
 
 
-def run_to_completion(workdir: str, steps: int, timeout: float,
-                      resume: bool = False, env: dict | None = None) -> int:
-    child = Child(workdir, steps, resume=resume, env=env)
+def run_argv_to_completion(workdir: str, argv: list[str], timeout: float,
+                           log_name: str, env: dict | None = None) -> int:
+    child = Child(workdir, argv, log_name=log_name, env=env)
     rc = child.wait(timeout)
     if rc not in (0,):
         raise AssertionError(
             f"child exited {rc} (log: {child.log_path})\n{child.log_tail()}"
         )
     return rc
+
+
+def run_to_completion(workdir: str, steps: int, timeout: float,
+                      resume: bool = False, env: dict | None = None) -> int:
+    return run_argv_to_completion(
+        workdir, train_argv(workdir, steps, resume), timeout,
+        log_name=f"child-{'resume' if resume else 'first'}", env=env,
+    )
 
 
 def bitwise_equal(workdir_a: str, workdir_b: str, timeout: float) -> bool:
@@ -212,7 +350,7 @@ def interrupt_at_checkpoint(workdir: str, steps: int, sig: int,
                             min_step: int, timeout: float) -> int:
     """Start a run, deliver ``sig`` once a checkpoint >= min_step is
     finalized, return the exit code."""
-    child = Child(workdir, steps)
+    child = Child(workdir, train_argv(workdir, steps))
     hit = wait_for(
         lambda: [s for s in finalized_steps(workdir) if s >= min_step],
         timeout,
@@ -320,12 +458,136 @@ def scenario_truncate(root: str, steps: int, timeout: float) -> dict:
             "bit_identical": True}
 
 
+# -- inference scenarios ------------------------------------------------------
+
+
+def shard_files(workdir: str) -> list[str]:
+    d = os.path.join(workdir, CONFIG, "eval_shards")
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        n for n in os.listdir(d)
+        if n.startswith("shard-") and n.endswith(".json")
+    )
+
+
+def _baseline_ckpt(root: str) -> str:
+    d = ckpt_dir(os.path.join(root, "baseline"))
+    assert os.path.isdir(d), "baseline scenario must run first"
+    return d
+
+
+def scenario_eval_sigkill(root: str, steps: int, timeout: float) -> dict:
+    ckpt = _baseline_ckpt(root)
+    ref = os.path.join(root, "eval_ref")
+    run_argv_to_completion(
+        ref, eval_argv(ref, ckpt), timeout, log_name="eval-ref"
+    )
+    with open(os.path.join(ref, "detections.json"), "rb") as f:
+        ref_bytes = f.read()
+
+    wd = os.path.join(root, "eval_sigkill")
+    child = Child(wd, eval_argv(wd, ckpt), log_name="eval-first")
+    hit = wait_for(lambda: shard_files(wd), timeout, poll=0.05)
+    if not hit:
+        child.signal(signal.SIGKILL)
+        child.wait(timeout)
+        raise AssertionError(
+            f"no shard checkpoint appeared within {timeout}s "
+            f"(log: {child.log_path})\n{child.log_tail()}"
+        )
+    child.signal(signal.SIGKILL)
+    rc = child.wait(timeout)
+    assert rc == -signal.SIGKILL, f"expected SIGKILL death, got rc={rc}"
+    partial = len(shard_files(wd))
+    assert 0 < partial < EVAL_LIMIT, (
+        f"kill left {partial}/{EVAL_LIMIT} shards — nothing to resume"
+    )
+    run_argv_to_completion(
+        wd, eval_argv(wd, ckpt, resume=True), timeout, log_name="eval-resume"
+    )
+    assert len(shard_files(wd)) == EVAL_LIMIT
+    with open(os.path.join(wd, "detections.json"), "rb") as f:
+        got = f.read()
+    assert got == ref_bytes, (
+        "resumed eval detections differ from the uninterrupted run"
+    )
+    return {"killed_after_shards": partial, "total_shards": EVAL_LIMIT,
+            "byte_identical": True}
+
+
+def scenario_eval_corrupt(root: str, steps: int, timeout: float) -> dict:
+    ckpt = _baseline_ckpt(root)
+    wd = os.path.join(root, "eval_corrupt")
+    bad = ["3", "7"]  # inside the --limit window of the synthetic split
+    run_argv_to_completion(
+        wd, eval_argv(wd, ckpt), timeout, log_name="eval-corrupt",
+        env={"MX_RCNN_CHAOS_BAD_IMAGES": ",".join(bad)},
+    )
+    qpath = os.path.join(wd, CONFIG, "quarantine.jsonl")
+    assert os.path.exists(qpath), "corrupt images were not quarantined"
+    with open(qpath) as f:
+        rows = [json.loads(line) for line in f]
+    quarantined = {str(r["image_id"]) for r in rows}
+    assert set(bad) <= quarantined, (
+        f"expected {bad} quarantined, got {sorted(quarantined)}"
+    )
+    with open(os.path.join(wd, "detections.json")) as f:
+        dump = json.load(f)
+    assert len(dump) == EVAL_LIMIT, (
+        f"dump holds {len(dump)}/{EVAL_LIMIT} images — corrupt inputs must "
+        "blank-substitute, not drop"
+    )
+    return {"quarantined": sorted(quarantined), "dump_images": len(dump)}
+
+
+def _json_child(root: str, name: str, flag: str, timeout: float) -> dict:
+    """Run a self-asserting child mode; return its JSON stdout line."""
+    wd = os.path.join(root, name)
+    os.makedirs(wd, exist_ok=True)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), flag],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO_ROOT,
+    )
+    with open(os.path.join(wd, "child.log"), "w") as f:
+        f.write(out.stdout + out.stderr)
+    assert out.returncode == 0, (
+        f"{name} child exited {out.returncode}:\n{out.stdout}\n{out.stderr}"
+    )
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"{name} child printed no JSON:\n{out.stdout}"
+    return json.loads(lines[-1])
+
+
+def scenario_overload(root: str, steps: int, timeout: float) -> dict:
+    r = _json_child(root, "overload", "--child-overload", timeout)
+    # The child already asserted shed >= 1 and served == submitted - shed;
+    # re-assert here so the summary line can't paper over a child bug.
+    assert r["shed"] >= 1 and r["served"] == r["submitted"] - r["shed"], r
+    return r
+
+
+def scenario_hang(root: str, steps: int, timeout: float) -> dict:
+    r = _json_child(root, "hang", "--child-hang", timeout)
+    assert r.get("hung") == 1 and r.get("state") == "dead", r
+    return r
+
+
 SCENARIOS = {
     "baseline": scenario_baseline,
     "sigkill": scenario_sigkill,
     "sigterm": scenario_sigterm,
     "nan": scenario_nan,
     "truncate": scenario_truncate,
+    "eval_sigkill": scenario_eval_sigkill,
+    "eval_corrupt": scenario_eval_corrupt,
+    "overload": scenario_overload,
+    "hang": scenario_hang,
+}
+
+# Scenarios that restore/compare against baseline's checkpoint.
+NEEDS_BASELINE = {
+    "sigkill", "sigterm", "truncate", "eval_sigkill", "eval_corrupt",
 }
 
 
@@ -335,6 +597,13 @@ def main(argv=None) -> int:
     if argv and argv[0] == "--child":
         rest = argv[2:] if argv[1:2] == ["--"] else argv[1:]
         return child_main(rest)
+    if argv and argv[0] == "--child-eval":
+        rest = argv[2:] if argv[1:2] == ["--"] else argv[1:]
+        return child_eval_main(rest)
+    if argv and argv[0] == "--child-overload":
+        return child_overload_main()
+    if argv and argv[0] == "--child-hang":
+        return child_hang_main()
     if argv and argv[0] == "--compare":
         return compare_main(argv[1], argv[2])
 
@@ -352,8 +621,9 @@ def main(argv=None) -> int:
 
     root = args.workdir or tempfile.mkdtemp(prefix="mx_rcnn_chaos_")
     names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
-    # Every recovery scenario compares against baseline's checkpoint.
-    if "baseline" not in names:
+    # Recovery scenarios restore/compare baseline's checkpoint; pure
+    # engine scenarios (overload/hang) don't pay for a training run.
+    if "baseline" not in names and NEEDS_BASELINE & set(names):
         names.insert(0, "baseline")
 
     results: dict[str, dict] = {}
