@@ -275,6 +275,72 @@ def _eval_bench(cfg, image_size, on_accel):
     return b / dt, b
 
 
+def _stage_breakdown(cfg, model, state, image_size, batch, platform, on_accel):
+    """One JSON line per train-step stage into the BENCH artifact.
+
+    Same prefix-ablation stage list as tools/perf_breakdown.py (shared in
+    mx_rcnn_tpu/utils/stage_bench.py) so future BENCH_r*.json files carry
+    their own regression localization: a throughput drop shows up as a
+    specific stage's delta growing, not as an unattributed headline number.
+    Stage lines print BEFORE the headline metric line so "last JSON line =
+    headline" keeps holding for existing consumers."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.detection import Batch
+    from mx_rcnn_tpu.train.loop import FREEZE_PREFIXES
+    from mx_rcnn_tpu.train.optim import frozen_mask
+    from mx_rcnn_tpu.utils.stage_bench import time_train_stages, train_stage_fns
+
+    h, w = image_size
+    b = batch
+    rng = np.random.RandomState(0)
+    g = cfg.data.max_gt_boxes
+    boxes = np.zeros((b, g, 4), np.float32)
+    boxes[:, :8] = [100.0, 100.0, 300.0, 300.0]
+    bt = Batch(
+        images=jnp.asarray(rng.randn(b, h, w, 3), jnp.float32),
+        image_hw=jnp.asarray([[float(h), float(w)]] * b, jnp.float32),
+        gt_boxes=jnp.asarray(boxes),
+        gt_classes=jnp.ones((b, g), jnp.int32),
+        gt_valid=jnp.asarray(np.tile(np.arange(g)[None] < 8, (b, 1))),
+    )
+    params = state.params
+    rest = state.model_state
+    if cfg.model.backbone.freeze_stages > 0:
+        mask = frozen_mask(
+            params, FREEZE_PREFIXES.get(cfg.model.backbone.name, ())
+        )
+
+        def masked(p):
+            return jax.tree_util.tree_map(
+                lambda x, t: x if t else jax.lax.stop_gradient(x), p, mask
+            )
+    else:
+        masked = None
+
+    stages = train_stage_fns(
+        model, params, rest, bt, jax.random.PRNGKey(1), masked=masked
+    )
+    results = time_train_stages(
+        stages, params, steps=10 if on_accel else 2, calls=2
+    )
+    label = f"@{h}x{w},b{b},{platform}"
+    prev = 0.0
+    for name, dt in results:
+        print(
+            json.dumps(
+                {
+                    "metric": f"train_stage_ms[{name}{label}]",
+                    "value": round(dt * 1e3, 3),
+                    "unit": "ms/step",
+                    "delta_ms": round((dt - prev) * 1e3, 3),
+                }
+            )
+        )
+        prev = dt
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--config", default="r50_fpn_coco")
@@ -289,6 +355,14 @@ def main() -> None:
         metavar="KEY.PATH=VALUE",
         help="config overrides for A/B probes (same syntax as train.py)",
     )
+    ap.add_argument(
+        "--breakdown", action=argparse.BooleanOptionalAction, default=None,
+        help="ALSO emit one JSON line per train-step stage (the "
+        "tools/perf_breakdown.py prefix ablation, shared via "
+        "mx_rcnn_tpu/utils/stage_bench.py) so the BENCH artifact localizes "
+        "regressions without a separate tool run.  Default: on for "
+        "accelerators, off for the CPU fallback (each stage recompiles).",
+    )
     args = ap.parse_args()
     if args.eval and args.loader:
         ap.error("--loader applies to the train bench only, not --eval")
@@ -297,12 +371,19 @@ def main() -> None:
 
     # Persistent compile cache: repeat bench invocations (fresh processes)
     # skip the multi-minute XLA compile of the K-step scan program.
-    # Repo-scoped path (not /tmp): safe on multi-user hosts.
+    # Repo-scoped path (not /tmp): safe on multi-user hosts.  Keyed by a
+    # backend + host-feature fingerprint (utils/compile_cache.py): the old
+    # un-keyed dir replayed XLA:CPU AOT blobs compiled on a DIFFERENT host
+    # when the checkout migrated between machines — the MULTICHIP_r0*
+    # "could lead to execution errors such as SIGILL" tails.
     import os
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+    from mx_rcnn_tpu.utils.compile_cache import configure_cache
+
+    configure_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        min_compile_secs=10,
+    )
 
     from mx_rcnn_tpu.config import apply_overrides, get_config
     from mx_rcnn_tpu.train.loop import build_all
@@ -407,6 +488,12 @@ def main() -> None:
 
     if args.loader:
         _loader_fed(cfg, step_fn, state, global_batch)
+
+    do_breakdown = args.breakdown if args.breakdown is not None else on_accel
+    if do_breakdown:
+        _stage_breakdown(
+            cfg, model, state, image_size, batch, platform, on_accel
+        )
 
     img_s = n_steps * batch / dt
     name = args.config.replace("_coco", "")

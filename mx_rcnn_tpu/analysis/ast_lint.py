@@ -76,10 +76,12 @@ RULES: dict[str, str] = {
 _HOST_CAST_NP = {"asarray", "array"}
 # TPU002 allowlist: attribute uses of numpy that are constants/dtypes, not
 # computations (np.float32 as a dtype argument, np.pi, np.inf, ...).
+# Includes the dtype-introspection calls (issubdtype/iinfo/finfo): static
+# host dispatch on an aval's dtype, never a computation on traced values.
 _NP_CONST_ATTRS = {
     "float32", "float16", "bfloat16", "int32", "int8", "uint8", "bool_",
     "pi", "inf", "nan", "newaxis", "ndarray", "dtype", "integer",
-    "floating",
+    "floating", "inexact", "issubdtype", "iinfo", "finfo",
 }
 # TPU005: calls that emit MXU (conv/dot) work.
 _MXU_CALL_NAMES = {

@@ -98,17 +98,54 @@ class RPNConfig:
     nms_threshold: float = 0.7
     min_size: float = 0.0
     loss_weight: float = 1.0
-    # Pre-NMS top-k selection over the anchor scores.  "exact" =
-    # lax.top_k (full sort network); "approx" = lax.approx_max_k (the
-    # TPU PartialReduce op) at ``topk_recall`` expected recall of the
-    # true top-k.  The k'th-ranked RPN scores are deep in the sigmoid
-    # tail, so the ~(1-recall) swapped candidates are low-objectness
-    # boxes NMS/top-post would drop anyway — but "exact" stays the
-    # default for reference parity; measured A/B in BASELINE.md.  Off
-    # TPU, approx_max_k lowers to a full sort (exact), so CPU tests and
-    # goldens see identical numbers either way.
-    topk_impl: str = "exact"
+    # Pre-NMS top-k selection over the anchor scores.  "hier" — the
+    # default — is the blocked two-stage exact reduction
+    # (ops/topk.py::hierarchical_top_k): per-tile partial top-k then a
+    # merge of survivors, BIT-IDENTICAL to lax.top_k including the
+    # snapped-score index-stable tie-breaks (proof in the module
+    # docstring, asserted in tests/test_ops.py), but the sort shrinks
+    # from the full 268k-anchor operand to ``topk_block``-wide tiles.
+    # "exact" = the global lax.top_k (one full sort network — the
+    # oracle).  "approx" = lax.approx_max_k (the TPU PartialReduce op)
+    # at ``topk_recall`` expected recall of the true top-k: the
+    # k'th-ranked RPN scores are deep in the sigmoid tail, so the
+    # ~(1-recall) swapped candidates are low-objectness boxes
+    # NMS/top-post would drop anyway — a first-class A/B'able training
+    # option (measured +1.1 img/s over "exact" in r4b), opt-in because
+    # it is the one impl that changes proposals.  Off TPU,
+    # approx_max_k lowers to a full sort (exact), so CPU tests and
+    # goldens see identical numbers for ALL three impls.
+    topk_impl: str = "hier"
     topk_recall: float = 0.95
+    # Tile width for the "hier" reduction (also routes the anchor
+    # subsampling top_k's in ops/sampling.py::_select_random).  Any
+    # value gives the same bits; power-of-two multiples of the 128-lane
+    # VPU width keep the batched per-tile sort layout-friendly.  <= 0
+    # falls back to the global sort.
+    topk_block: int = 32768
+    # Anchor-axis tile for assign_anchors' IoU/argmax reductions
+    # (ops/sampling.py::_per_anchor_stats_blocked): the (A, G) IoU
+    # matrix (34 MB at the recipe canvas) never materializes — each
+    # tile's IoU is computed and reduced in one VMEM-resident fusion.
+    # Bit-identical to the dense pass (f32 max is exactly associative);
+    # <= 0 restores the single-pass dense form.
+    assign_block: int = 16384
+    # RPN loss reduction domain.  "dense" (default) reduces BCE/smooth-l1
+    # over the full (B, A) anchor axis with masks — the historical form,
+    # bit-identical to pre-fast-path builds.  "compact" gathers the
+    # Q = fg_quota + batch_size sampled rows (AnchorTargets.sel_*) and
+    # reduces only those: the same loss up to summation order (the
+    # masked-out terms are exact zeros), so metrics match to f32
+    # round-off, not bitwise — opt-in for A/B.
+    loss_impl: str = "dense"
+    # Sweep bound for the proposal NMS fixed point (ops/nms.py).  0 =
+    # iterate to convergence (exact greedy NMS, the default).  > 0 caps
+    # the batched per-level lane at that many sweeps: any cap >= N is
+    # still exact and score-sorted RPN boxes converge in a handful of
+    # sweeps, so a cap like 16 bounds the worst lane's data-dependent
+    # latency while matching exact NMS on everything but adversarial
+    # box soups.
+    nms_sweep_cap: int = 0
     # Run the weight-shared head over all FPN levels as ONE packed
     # computation (models/heads.py::RPNHead.packed) instead of five
     # sequential small-spatial convs (the P2 apply alone measured
@@ -143,6 +180,12 @@ class RCNNConfig:
     # (flattened-pyramid gather — the oracle, the backward, and the
     # automatic fallback off-TPU or on unsupported layouts).
     roi_align_impl: str = "pallas"
+    # Backward for the pallas forward: "pallas" (default — the windowed-DMA
+    # scatter-accumulate kernel ops/pallas/roi_align.py::_bwd_kernel, the
+    # r3 default previously selected only via env) or "xla" (autodiff
+    # through the flattened gather — the A/B and debugging escape hatch).
+    # The MX_RCNN_POOL_BWD env var still overrides at trace time.
+    roi_align_bwd_impl: str = "pallas"
 
 
 @dataclass(frozen=True)
@@ -188,6 +231,9 @@ class TestConfig:
     # 82.1 -> 94.9 img/s/chip.
     nms_mode: str = "fused"
     fused_top_k: int = 1000
+    # Sweep bound for the postprocess NMS fixed points (same semantics
+    # as RPNConfig.nms_sweep_cap; 0 = exact convergence, the default).
+    nms_sweep_cap: int = 0
 
 
 @dataclass(frozen=True)

@@ -16,6 +16,7 @@ Shape conventions:
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple, Optional
 
 import jax
@@ -29,7 +30,7 @@ from mx_rcnn_tpu.geometry import (
     decode_boxes,
     generate_base_anchors,
     masked_softmax_cross_entropy,
-    shifted_anchors,
+    shifted_anchors_np,
     weighted_smooth_l1,
 )
 from mx_rcnn_tpu.ops import assign_anchors, generate_proposals, roi_align, sample_rois
@@ -78,6 +79,23 @@ class Detections(NamedTuple):
 # Anchors
 
 
+@lru_cache(maxsize=64)
+def _cached_level_anchor(stride: int, ratios, scales, h: int, w: int):
+    """One level's anchor grid, memoized as host numpy.
+
+    ``generate_base_anchors``/``shifted_anchors`` enumerate the grid in
+    host numpy — O(H*W*k) work the old code redid on EVERY trace (retrace
+    per canvas orientation, per eval bucket, per chaos-restart).  The
+    geometry is a pure function of this static key, so cache it; repeated
+    traces of the same shapes reuse it for free.  Cached in NUMPY form on
+    purpose: a jnp array built while tracing is a tracer, and handing a
+    cached tracer to a later trace leaks it.  ``level_anchors`` does the
+    (cheap, constant-embedding) jnp.asarray per trace.
+    """
+    base = generate_base_anchors(base_size=stride, ratios=ratios, scales=scales)
+    return shifted_anchors_np(base, stride, h, w)
+
+
 def level_anchors(
     cfg: ModelConfig, feats: dict[int, jnp.ndarray]
 ) -> dict[int, jnp.ndarray]:
@@ -90,11 +108,10 @@ def level_anchors(
     out = {}
     for lvl in sorted(feats):
         stride = 2**lvl
-        base = generate_base_anchors(
-            base_size=stride, ratios=cfg.anchors.ratios, scales=cfg.anchors.scales
-        )
         _, h, w, _ = feats[lvl].shape
-        out[lvl] = shifted_anchors(base, stride, h, w)
+        out[lvl] = jnp.asarray(_cached_level_anchor(
+            stride, tuple(cfg.anchors.ratios), tuple(cfg.anchors.scales), h, w
+        ))
     return out
 
 
@@ -102,7 +119,7 @@ def level_anchors(
 # Losses
 
 
-def _rpn_losses(rpn_logits, rpn_deltas, targets):
+def _rpn_losses(rpn_logits, rpn_deltas, targets, loss_impl: str = "dense"):
     """RPN objectness + box losses, per reference normalization.
 
     rpn_logits (B, A), rpn_deltas (B, A, 4); targets from assign_anchors
@@ -111,8 +128,24 @@ def _rpn_losses(rpn_logits, rpn_deltas, targets):
     ignore_label=-1 and normalization='valid' — same quantity); box loss is
     smooth_l1(sigma=3) on fg anchors normalized by the same count
     (reference grad_scale = 1/RPN_BATCH_SIZE per image).
+
+    ``loss_impl``: "dense" reduces over the full (B, A) anchor axis with
+    masks (bit-identical to the historical form); "compact" reduces only
+    the Q sampled rows via AnchorTargets.sel_* — same terms, different
+    summation order (see RPNConfig.loss_impl).
     """
     with jax.named_scope("rpn_loss"):
+        if loss_impl == "compact":
+            if targets.sel_idx is None:
+                raise ValueError(
+                    "loss_impl='compact' needs AnchorTargets.sel_* (produced "
+                    "by assign_anchors)"
+                )
+            return _rpn_losses_compact(rpn_logits, rpn_deltas, targets)
+        if loss_impl != "dense":
+            raise ValueError(
+                f"rpn.loss_impl must be 'dense' or 'compact', got {loss_impl!r}"
+            )
         return _rpn_losses_impl(rpn_logits, rpn_deltas, targets)
 
 
@@ -138,6 +171,47 @@ def _rpn_losses_impl(rpn_logits, rpn_deltas, targets):
 
     pred_fg = rpn_logits > 0.0
     acc = jnp.sum((pred_fg == (labels == 1)) * valid) / n_valid
+    return cls_loss, box_loss, acc
+
+
+def _rpn_losses_compact(rpn_logits, rpn_deltas, targets):
+    """RPN losses over the Q sampled anchor rows only.
+
+    The dense form reduces BCE over all (B, A) anchors with at most
+    ``batch_size`` nonzero terms per image; here the assignment masks are
+    fused into the loss by gathering the sampled rows assign_anchors
+    already knows (``sel_idx`` — the subsample top_k's own output), so
+    forward AND backward touch Q = fg_quota + batch_size rows per image
+    instead of A = 268k.  Same loss terms (every masked-out dense term is
+    an exact 0.0); only the summation order differs, so metrics agree to
+    f32 round-off rather than bitwise.  The accuracy metric is a 0/1
+    count and matches the dense value exactly.
+    """
+    idx = targets.sel_idx              # (B, Q)
+    take = targets.sel_take.astype(rpn_logits.dtype)
+    is_fg = targets.sel_fg             # (B, Q)
+    n_valid = jnp.maximum(jnp.sum(take), 1.0)
+
+    logit_sel = jnp.take_along_axis(rpn_logits, idx, axis=1)      # (B, Q)
+    fgf = is_fg.astype(rpn_logits.dtype)
+    bce = -(
+        fgf * jax.nn.log_sigmoid(logit_sel)
+        + (1.0 - fgf) * jax.nn.log_sigmoid(-logit_sel)
+    )
+    cls_loss = jnp.sum(bce * take) / n_valid
+
+    deltas_sel = jnp.take_along_axis(rpn_deltas, idx[..., None], axis=1)
+    targets_sel = jnp.take_along_axis(targets.bbox_targets, idx[..., None], axis=1)
+    box_loss = weighted_smooth_l1(
+        deltas_sel,
+        targets_sel,
+        inside_weight=fgf[..., None],
+        sigma=3.0,
+        normalizer=n_valid,
+    )
+
+    pred_fg = logit_sel > 0.0
+    acc = jnp.sum((pred_fg == is_fg) * take) / n_valid
     return cls_loss, box_loss, acc
 
 
@@ -202,12 +276,16 @@ def _propose_one(cfg: ModelConfig, train: bool):
                 pre_nms_top_n=pre, post_nms_top_n=post,
                 nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
                 topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
+                topk_block=rpn_cfg.topk_block,
+                nms_sweep_cap=rpn_cfg.nms_sweep_cap,
             )
         return generate_fpn_proposals(
             level_scores, level_deltas, level_anchor, hw[0], hw[1],
             pre_nms_top_n=pre, post_nms_top_n=post,
             nms_threshold=rpn_cfg.nms_threshold, min_size=rpn_cfg.min_size,
             topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
+            topk_block=rpn_cfg.topk_block,
+            nms_sweep_cap=rpn_cfg.nms_sweep_cap,
         )
 
     return single
@@ -279,6 +357,11 @@ def _pool_rois_impl(cfg: ModelConfig, feats, rois, pooled_size: int,
             f"rcnn.roi_align_impl must be 'xla' or 'pallas', "
             f"got {cfg.rcnn.roi_align_impl!r}"
         )
+    if cfg.rcnn.roi_align_bwd_impl not in ("xla", "pallas"):
+        raise ValueError(
+            f"rcnn.roi_align_bwd_impl must be 'xla' or 'pallas', "
+            f"got {cfg.rcnn.roi_align_bwd_impl!r}"
+        )
     levels = sorted(feats)
     want_pallas = cfg.rcnn.roi_align_impl == "pallas"
     roi_levels = {l: f for l, f in feats.items() if l in roi_level_set}
@@ -310,13 +393,14 @@ def _pool_rois_impl(cfg: ModelConfig, feats, rois, pooled_size: int,
                 return sharded_multilevel_roi_align(
                     roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio,
                     mesh, DATA_AXIS, interpret=interpret,
+                    bwd_impl=cfg.rcnn.roi_align_bwd_impl,
                 )
             # Whole batch in ONE kernel launch: the batch folds into the
             # pallas grid (B*R roi steps), no per-image python unroll.
             LAST_POOL_IMPL = "pallas"
             return multilevel_roi_align_fast(
                 roi_levels, rois, pooled_size, cfg.rcnn.sampling_ratio,
-                POOL_WINDOW, interpret,
+                POOL_WINDOW, interpret, cfg.rcnn.roi_align_bwd_impl,
             )
         LAST_POOL_IMPL = "xla"
         return jax.vmap(
@@ -518,7 +602,9 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
                 batch.image_hw,
             )
 
-        rpn_cls, rpn_box, rpn_acc = _rpn_losses(logits_cat, deltas_cat, targets)
+        rpn_cls, rpn_box, rpn_acc = _rpn_losses(
+            logits_cat, deltas_cat, targets, cfg.rpn.loss_impl
+        )
 
     if use_ext:
         prop_rois, prop_valid = batch.ext_rois, batch.ext_valid
@@ -621,6 +707,8 @@ def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w, gt_ignore=N
         negative_iou=cfg.rpn.negative_iou,
         allowed_border=cfg.rpn.allowed_border,
         gt_ignore=gt_ignore,
+        assign_block=cfg.rpn.assign_block,
+        topk_block=cfg.rpn.topk_block,
     )
 
 
@@ -747,7 +835,8 @@ def _postprocess_one(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw):
         top_s, top_i = lax.top_k(sc, per_class_k)
         top_b = jnp.take(boxes, top_i, axis=0)
         keep_i, keep_v = nms_indices(
-            top_b, top_s, cfg.test.nms_threshold, per_class_k
+            top_b, top_s, cfg.test.nms_threshold, per_class_k,
+            sweep_cap=cfg.test.nms_sweep_cap,
         )
         out_b = jnp.take(top_b, keep_i, axis=0)
         out_s = jnp.where(keep_v, jnp.take(top_s, keep_i), -jnp.inf)
@@ -809,7 +898,8 @@ def _postprocess_one_fused(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw)
 
     cand_valid = jnp.isfinite(top_s)
     keep = batched_nms(
-        boxes, top_s, cls, cfg.test.nms_threshold, valid=cand_valid
+        boxes, top_s, cls, cfg.test.nms_threshold, valid=cand_valid,
+        sweep_cap=cfg.test.nms_sweep_cap,
     )
     kept_s = jnp.where(keep, top_s, -jnp.inf)
     out_s, out_i = lax.top_k(kept_s, min(d_out, k))

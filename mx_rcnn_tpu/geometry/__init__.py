@@ -8,7 +8,11 @@ from mx_rcnn_tpu.geometry.boxes import (
     snap,
     valid_box_mask,
 )
-from mx_rcnn_tpu.geometry.anchors import generate_base_anchors, shifted_anchors
+from mx_rcnn_tpu.geometry.anchors import (
+    generate_base_anchors,
+    shifted_anchors,
+    shifted_anchors_np,
+)
 from mx_rcnn_tpu.geometry.losses import (
     huber_loss,
     masked_softmax_cross_entropy,
@@ -27,6 +31,7 @@ __all__ = [
     "valid_box_mask",
     "generate_base_anchors",
     "shifted_anchors",
+    "shifted_anchors_np",
     "huber_loss",
     "masked_softmax_cross_entropy",
     "smooth_l1",

@@ -74,10 +74,20 @@ def shifted_anchors(base_anchors, stride: int, height: int, width: int):
     bit-identical anchors instead of re-deriving them under whatever
     partitioning XLA picks for the constant-folded grid.
     """
+    return jnp.asarray(shifted_anchors_np(base_anchors, stride, height, width))
+
+
+def shifted_anchors_np(base_anchors, stride: int, height: int, width: int):
+    """:func:`shifted_anchors` as pure host numpy (no device transfer).
+
+    Callers that memoize the grid across traces (detection/graph.py::
+    _cached_level_anchor) must cache the numpy form: a jnp array produced
+    while tracing is a tracer, and returning it from a cache into a later
+    trace is a leak."""
     base = np.asarray(base_anchors, dtype=np.float32)
     shift_x = np.arange(width, dtype=np.float32) * stride
     shift_y = np.arange(height, dtype=np.float32) * stride
     sx, sy = np.meshgrid(shift_x, shift_y)  # (H, W)
     shifts = np.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
     out = shifts[:, :, None, :] + base[None, None, :, :]  # (H, W, k, 4)
-    return jnp.asarray(out.reshape(-1, 4))
+    return out.reshape(-1, 4)

@@ -2,6 +2,7 @@ from mx_rcnn_tpu.ops.nms import batched_nms, nms_mask
 from mx_rcnn_tpu.ops.roi_align import roi_align, multilevel_roi_align
 from mx_rcnn_tpu.ops.proposals import generate_proposals
 from mx_rcnn_tpu.ops.sampling import sample_rois, assign_anchors
+from mx_rcnn_tpu.ops.topk import hierarchical_top_k
 
 __all__ = [
     "batched_nms",
@@ -11,4 +12,5 @@ __all__ = [
     "generate_proposals",
     "sample_rois",
     "assign_anchors",
+    "hierarchical_top_k",
 ]

@@ -42,6 +42,7 @@ def nms_mask(
     scores: jnp.ndarray,
     iou_threshold: float,
     valid: jnp.ndarray | None = None,
+    sweep_cap: int = 0,
 ) -> jnp.ndarray:
     """Greedy NMS as a boolean keep-mask in *input* order.
 
@@ -52,6 +53,14 @@ def nms_mask(
       iou_threshold: suppression threshold (reference default 0.7 for RPN
         proposals, 0.3 at test time).
       valid: optional (N,) bool; invalid entries never keep nor suppress.
+      sweep_cap: 0 (default) iterates the fixed point to convergence —
+        exact greedy NMS.  > 0 bounds the while_loop to that many sweeps:
+        each sweep finalizes at least one undecided box, so any cap >= N
+        is still exact, and score-sorted RPN boxes converge in a handful
+        of sweeps regardless; a small cap trades exactness on adversarial
+        inputs for a hard latency bound (the batched per-level lane then
+        pays a bounded worst case instead of the slowest lane's
+        data-dependent sweep count).  Opt-in via ``RPNConfig.nms_sweep_cap``.
 
     Returns:
       (N,) bool keep mask.
@@ -73,28 +82,45 @@ def nms_mask(
     upper = jnp.triu(jnp.ones((n, n), dtype=bool), k=1)
     suppress = (iou > iou_threshold) & upper & svalid[:, None] & svalid[None, :]
 
-    def cond(state):
-        keep, prev = state
-        return jnp.any(keep != prev)
+    if sweep_cap and sweep_cap > 0:
+        # Bounded variant: identical iteration, with a sweep counter in
+        # the carry.  Convergence before the cap gives the exact greedy
+        # fixed point; hitting the cap returns the current iterate.
+        def cond(state):
+            keep, prev, it = state
+            return jnp.any(keep != prev) & (it < sweep_cap)
 
-    def body(state):
-        keep, _ = state
-        new_keep = svalid & ~jnp.any(suppress & keep[:, None], axis=0)
-        return new_keep, keep
+        def body(state):
+            keep, _, it = state
+            new_keep = svalid & ~jnp.any(suppress & keep[:, None], axis=0)
+            return new_keep, keep, it + 1
 
-    init = (svalid, jnp.zeros(n, dtype=bool))
-    keep_sorted, _ = lax.while_loop(cond, body, init)
+        init = (svalid, jnp.zeros(n, dtype=bool), jnp.asarray(0, jnp.int32))
+        keep_sorted, _, _ = lax.while_loop(cond, body, init)
+    else:
+        def cond(state):
+            keep, prev = state
+            return jnp.any(keep != prev)
+
+        def body(state):
+            keep, _ = state
+            new_keep = svalid & ~jnp.any(suppress & keep[:, None], axis=0)
+            return new_keep, keep
+
+        init = (svalid, jnp.zeros(n, dtype=bool))
+        keep_sorted, _ = lax.while_loop(cond, body, init)
 
     return jnp.zeros(n, dtype=bool).at[order].set(keep_sorted)
 
 
-@partial(jax.jit, static_argnums=(2, 3))
+@partial(jax.jit, static_argnums=(2, 3), static_argnames=("sweep_cap",))
 def nms_indices(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
     iou_threshold: float,
     max_outputs: int,
     valid: jnp.ndarray | None = None,
+    sweep_cap: int = 0,
 ):
     """NMS returning up to ``max_outputs`` kept indices, score-descending.
 
@@ -104,7 +130,7 @@ def nms_indices(
     (``rcnn/symbol/proposal.py`` pads rois to RPN_POST_NMS_TOP_N).
     """
     n = boxes.shape[0]
-    keep = nms_mask(boxes, scores, iou_threshold, valid)
+    keep = nms_mask(boxes, scores, iou_threshold, valid, sweep_cap=sweep_cap)
     # Rank kept entries by score; drop the rest to the tail.
     neg = jnp.where(keep, -scores, jnp.inf)
     order = jnp.argsort(neg)  # kept entries first, best score first
@@ -125,6 +151,7 @@ def batched_nms(
     classes: jnp.ndarray,
     iou_threshold: float,
     valid: jnp.ndarray | None = None,
+    sweep_cap: int = 0,
 ) -> jnp.ndarray:
     """Per-class NMS in one shot via the coordinate-offset trick.
 
@@ -135,4 +162,5 @@ def batched_nms(
     """
     span = jnp.max(boxes) - jnp.min(boxes) + 1.0
     offset = classes.astype(boxes.dtype)[:, None] * span
-    return nms_mask(boxes + offset, scores, iou_threshold, valid)
+    return nms_mask(boxes + offset, scores, iou_threshold, valid,
+                    sweep_cap=sweep_cap)

@@ -698,7 +698,7 @@ def pallas_supported(feature_pyramid: dict, window: int = POOL_WINDOW) -> bool:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5)
+    jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6)
 )
 def multilevel_roi_align_fast(
     feature_pyramid: dict[int, jnp.ndarray],
@@ -707,30 +707,38 @@ def multilevel_roi_align_fast(
     sampling_ratio: int = 2,
     window: int = POOL_WINDOW,
     interpret: bool = False,
+    bwd_impl: str = "pallas",
 ) -> jnp.ndarray:
-    """Pallas forward + XLA-reference backward.
+    """Pallas forward + selectable backward.
 
-    Forward runs the kernel above; the VJP differentiates the XLA
-    implementation of the same function (:func:`multilevel_roi_align` with
-    the matching extent-aware level assignment), which is exact because
-    both compute identical outputs.  Roi coordinates get no gradient (the
-    reference's Proposal/ProposalTarget custom ops are forward-only too —
-    SURVEY.md §4.1).  ``interpret`` runs the kernel's pure-JAX emulation
-    (CPU fake-mesh tests and the driver's multichip dryrun)."""
+    Forward runs the kernel above; ``bwd_impl`` picks the VJP — "pallas"
+    (default) is the window-RMW scatter-accumulate kernel
+    (:func:`multilevel_roi_align_bwd_pallas`), "xla" differentiates the
+    XLA implementation of the same function (:func:`multilevel_roi_align`
+    with the matching extent-aware level assignment), which is exact
+    because both compute identical outputs.  The config spelling is
+    ``rcnn.roi_align_bwd_impl``; the MX_RCNN_POOL_BWD env var overrides
+    either at trace time (A/B without touching the config).  Roi
+    coordinates get no gradient (the reference's Proposal/ProposalTarget
+    custom ops are forward-only too — SURVEY.md §4.1).  ``interpret``
+    runs the kernel's pure-JAX emulation (CPU fake-mesh tests and the
+    driver's multichip dryrun)."""
     return multilevel_roi_align_pallas(
         feature_pyramid, rois, output_size=output_size,
         sampling_ratio=sampling_ratio, window=window, interpret=interpret,
     )
 
 
-def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window, interpret):
+def _fast_fwd(feature_pyramid, rois, output_size, sampling_ratio, window,
+              interpret, bwd_impl):
     out = multilevel_roi_align_fast(
-        feature_pyramid, rois, output_size, sampling_ratio, window, interpret
+        feature_pyramid, rois, output_size, sampling_ratio, window, interpret,
+        bwd_impl,
     )
     return out, (feature_pyramid, rois)
 
 
-def _fast_bwd(output_size, sampling_ratio, window, interpret, res, g):
+def _fast_bwd(output_size, sampling_ratio, window, interpret, bwd_impl, res, g):
     import os
 
     feature_pyramid, rois = res
@@ -738,8 +746,9 @@ def _fast_bwd(output_size, sampling_ratio, window, interpret, res, g):
     # Pallas window-RMW backward by default (the XLA autodiff backward is
     # a duplicate-index HBM scatter-add the TPU serializes: 18-19 ms/step
     # at R101-FPN train shapes vs ~3 ms for the kernel — see _bwd_kernel).
-    # MX_RCNN_POOL_BWD=xla restores the old path for A/B and debugging.
-    if os.environ.get("MX_RCNN_POOL_BWD", "pallas") != "xla":
+    # rcnn.roi_align_bwd_impl="xla" (or MX_RCNN_POOL_BWD=xla, which wins)
+    # restores the old path for A/B and debugging.
+    if os.environ.get("MX_RCNN_POOL_BWD", bwd_impl) != "xla":
         grad_pyramid = multilevel_roi_align_bwd_pallas(
             feature_pyramid, rois, g, output_size=output_size,
             sampling_ratio=sampling_ratio, window=window, interpret=interpret,
@@ -775,6 +784,7 @@ def sharded_multilevel_roi_align(
     data_axis: str,
     window: int = POOL_WINDOW,
     interpret: bool = False,
+    bwd_impl: str = "pallas",
 ) -> jnp.ndarray:
     """The kernel's multi-chip form: :func:`multilevel_roi_align_fast`
     per data-axis shard via ``jax.shard_map``.
@@ -789,13 +799,14 @@ def sharded_multilevel_roi_align(
     ``check_vma=False``: the pallas out_shape carries no varying-mesh-axes
     annotation.  The custom_vjp rides inside, so the backward (the Pallas
     window-RMW kernel by default since r3; autodiff-of-XLA under
-    MX_RCNN_POOL_BWD=xla) is per-shard too."""
+    ``bwd_impl="xla"`` or MX_RCNN_POOL_BWD=xla) is per-shard too."""
     from jax.sharding import PartitionSpec as P
 
     # Positional call: custom_vjp nondiff_argnums forbid keywords.
     def fn(pyramid, shard_rois):
         return multilevel_roi_align_fast(
-            pyramid, shard_rois, output_size, sampling_ratio, window, interpret
+            pyramid, shard_rois, output_size, sampling_ratio, window, interpret,
+            bwd_impl,
         )
 
     if hasattr(jax, "shard_map"):

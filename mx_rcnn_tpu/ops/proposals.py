@@ -18,6 +18,7 @@ from jax import lax
 
 from mx_rcnn_tpu.geometry import clip_boxes, decode_boxes, snap, valid_box_mask
 from mx_rcnn_tpu.ops.nms import nms_indices
+from mx_rcnn_tpu.ops.topk import hierarchical_top_k
 
 
 class Proposals(NamedTuple):
@@ -36,8 +37,10 @@ def generate_proposals(
     post_nms_top_n: int = 300,
     nms_threshold: float = 0.7,
     min_size: float = 0.0,
-    topk_impl: str = "exact",
+    topk_impl: str = "hier",
     topk_recall: float = 0.95,
+    topk_block: int = 32768,
+    nms_sweep_cap: int = 0,
 ) -> Proposals:
     """Single-level proposal generation.
 
@@ -49,20 +52,25 @@ def generate_proposals(
       pre_nms_top_n / post_nms_top_n / nms_threshold / min_size: the
         reference's RPN_PRE_NMS_TOP_N / RPN_POST_NMS_TOP_N /
         config.TRAIN.RPN_NMS_THRESH / RPN_MIN_SIZE.
-      topk_impl / topk_recall: pre-NMS selection operator — see
-        ``RPNConfig.topk_impl`` (config.py) for the semantics/parity
-        argument.  Only the strict-subset case (k < A) can go approx;
-        k == A is a plain sort either way.
+      topk_impl / topk_recall / topk_block: pre-NMS selection operator —
+        see ``RPNConfig.topk_impl`` (config.py) for the semantics/parity
+        argument.  ``"hier"`` (default) is the blocked exact top-k
+        (bit-identical to ``"exact"``, see ``ops/topk.py``); only the
+        strict-subset case (k < A) can go approx; k == A is a plain sort
+        either way.
+      nms_sweep_cap: 0 (default) runs the NMS fixed point to convergence
+        (exact); > 0 bounds the sweep count (see ``ops/nms.py``).
 
     Returns:
       Fixed-size Proposals; invalid slots carry zeros.
     """
     boxes, masked_scores = _pre_nms_candidates(
         scores, deltas, anchors, image_height, image_width,
-        pre_nms_top_n, min_size, topk_impl, topk_recall,
+        pre_nms_top_n, min_size, topk_impl, topk_recall, topk_block,
     )
     keep_idx, keep_valid = nms_indices(
-        boxes, masked_scores, nms_threshold, post_nms_top_n
+        boxes, masked_scores, nms_threshold, post_nms_top_n,
+        sweep_cap=nms_sweep_cap,
     )
     rois = jnp.take(boxes, keep_idx, axis=0) * keep_valid[:, None]
     out_scores = jnp.where(keep_valid, jnp.take(masked_scores, keep_idx), 0.0)
@@ -72,6 +80,7 @@ def generate_proposals(
 def _pre_nms_candidates(
     scores, deltas, anchors, image_height, image_width,
     pre_nms_top_n: int, min_size: float, topk_impl: str, topk_recall: float,
+    topk_block: int = 32768,
 ):
     """Shared pre-NMS front half: top-k by objectness, decode, clip, and
     min-size masking.  Returns (boxes (k, 4), masked_scores (k,)) with
@@ -88,11 +97,15 @@ def _pre_nms_candidates(
         top_scores, top_idx = lax.approx_max_k(
             scores, k, recall_target=topk_recall
         )
+    elif topk_impl == "hier":
+        # Blocked exact top-k — bit-identical to lax.top_k including the
+        # snapped-score index-stable tie-breaks (proof in ops/topk.py).
+        top_scores, top_idx = hierarchical_top_k(scores, k, block=topk_block)
     elif topk_impl in ("exact", "approx"):
         top_scores, top_idx = lax.top_k(scores, k)
     else:
         raise ValueError(
-            f"topk_impl must be 'exact' or 'approx', got {topk_impl!r}"
+            f"topk_impl must be 'hier', 'exact' or 'approx', got {topk_impl!r}"
         )
     boxes = decode_boxes(
         jnp.take(deltas, top_idx, axis=0), jnp.take(anchors, top_idx, axis=0)
@@ -121,8 +134,10 @@ def generate_fpn_proposals(
     post_nms_top_n: int = 1000,
     nms_threshold: float = 0.7,
     min_size: float = 0.0,
-    topk_impl: str = "exact",
+    topk_impl: str = "hier",
     topk_recall: float = 0.95,
+    topk_block: int = 32768,
+    nms_sweep_cap: int = 0,
 ) -> Proposals:
     """FPN-style proposals: per-level top-k + NMS, then global top-k by score.
 
@@ -143,7 +158,7 @@ def generate_fpn_proposals(
         _pre_nms_candidates(
             level_scores[lvl], level_deltas[lvl], level_anchors[lvl],
             image_height, image_width,
-            pre_nms_top_n, min_size, topk_impl, topk_recall,
+            pre_nms_top_n, min_size, topk_impl, topk_recall, topk_block,
         )
         for lvl in levels
     ]
@@ -159,7 +174,9 @@ def generate_fpn_proposals(
     )                                                       # (L, kmax)
 
     keep_idx, keep_valid = jax.vmap(
-        lambda b, s: nms_indices(b, s, nms_threshold, post_nms_top_n)
+        lambda b, s: nms_indices(
+            b, s, nms_threshold, post_nms_top_n, sweep_cap=nms_sweep_cap
+        )
     )(bx, sc)                                               # (L, post), (L, post)
     rois_l = jnp.take_along_axis(
         bx, keep_idx[..., None], axis=1

@@ -21,8 +21,10 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from mx_rcnn_tpu.geometry import encode_boxes, ioa_matrix, iou_matrix, snap
+from mx_rcnn_tpu.ops.topk import hierarchical_top_k
 
 
 def _ignore_overlap_mask(
@@ -64,8 +66,13 @@ def _random_rank(key: jax.Array, candidate: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select_random(
-    key: jax.Array, candidate: jnp.ndarray, n, quota: int
-) -> jnp.ndarray:
+    key: jax.Array,
+    candidate: jnp.ndarray,
+    n,
+    quota: int,
+    block: int = 0,
+    with_indices: bool = False,
+):
     """Uniform-random boolean selection of ``n`` (traced, <= static
     ``quota``) of the candidates.
 
@@ -74,14 +81,28 @@ def _select_random(
     the scatter from N-wide to quota-wide.  Exact — ties are broken inside
     top_k by index, and exactly ``min(n, #candidates)`` entries come back
     True (callers pass ``n <= #candidates``).
+
+    ``block`` > 0 routes the top_k through the blocked exact reduction
+    (``ops/topk.py`` — bit-identical, avoids the full 268k-anchor sort).
+    ``with_indices`` additionally returns ``(idx (quota,), take (quota,))``
+    — the selected anchor rows and their active-slot mask — so callers
+    can run losses on the compact selected set instead of the full
+    anchor axis (``RPNConfig.loss_impl == "compact"``).
     """
     a = candidate.shape[0]
     n = jnp.minimum(n, jnp.sum(candidate))  # total: never select non-candidates
     pri = jax.random.uniform(key, (a,))
     pri = jnp.where(candidate, pri, -1.0)  # non-candidates last under max
-    _, idx = jax.lax.top_k(pri, min(quota, a))  # quota most-prior candidates
+    k = min(quota, a)
+    if block and block > 0:
+        _, idx = hierarchical_top_k(pri, k, block=block)
+    else:
+        _, idx = jax.lax.top_k(pri, k)  # quota most-prior candidates
     take = jnp.arange(idx.shape[0]) < n
-    return jnp.zeros((a,), bool).at[idx].set(take)
+    mask = jnp.zeros((a,), bool).at[idx].set(take)
+    if with_indices:
+        return mask, idx, take
+    return mask
 
 
 class AnchorTargets(NamedTuple):
@@ -89,39 +110,25 @@ class AnchorTargets(NamedTuple):
     bbox_targets: jnp.ndarray  # (A, 4) encode of matched gt (fg rows only meaningful)
     fg_mask: jnp.ndarray       # (A,) bool
     valid_mask: jnp.ndarray    # (A,) bool: labels != -1 (loss-contributing)
+    # Compact view of the sampled minibatch (fg quota block then bg quota
+    # block): the anchor rows the losses actually touch.  Lets the RPN
+    # loss gather Q = fg_quota + batch_size rows instead of reducing over
+    # all A anchors (``RPNConfig.loss_impl == "compact"``).  Inactive
+    # slots have sel_take False (their sel_idx is an arbitrary row).
+    sel_idx: jnp.ndarray | None = None   # (Q,) int32 anchor rows
+    sel_take: jnp.ndarray | None = None  # (Q,) bool: slot is a real sample
+    sel_fg: jnp.ndarray | None = None    # (Q,) bool: slot is a fg sample
 
 
-def assign_anchors(
-    key: jax.Array,
-    anchors: jnp.ndarray,
-    gt_boxes: jnp.ndarray,
-    gt_valid: jnp.ndarray,
-    image_height,
-    image_width,
-    batch_size: int = 256,
-    fg_fraction: float = 0.5,
-    positive_iou: float = 0.7,
-    negative_iou: float = 0.3,
-    allowed_border: float = 0.0,
-    gt_ignore: jnp.ndarray | None = None,
-    ignore_ioa: float = 0.5,
-) -> AnchorTargets:
-    """Label anchors for RPN training (reference assign_anchor semantics).
+def _per_anchor_stats_dense(
+    anchors, gt_boxes, gt_valid, gt_ignore,
+    image_height, image_width, allowed_border, ignore_ioa,
+):
+    """Single-pass (A, G) reduction: the original assign_anchors middle.
 
-    - anchors crossing the image boundary (by more than ``allowed_border``)
-      are ignored;
-    - fg: IoU >= positive_iou with some gt, PLUS every gt's best anchor
-      (so each gt gets at least one positive even below the threshold);
-    - bg: max IoU < negative_iou;
-    - subsample to ``batch_size`` with at most ``fg_fraction`` positives;
-      leftover fg quota is given to bg (reference behavior).
-
-    ``gt_boxes`` is padded to a static G with ``gt_valid`` masking; slots
-    flagged in ``gt_ignore`` (COCO crowd / VOC difficult) are never fg
-    matches, and anchors covering them (IoA >= ``ignore_ioa``) are excluded
-    from bg so crowds don't train as negatives.
+    Returns per-anchor ``(inside, max_iou, argmax_gt, is_gt_best,
+    in_ignore)`` plus the per-gt best IoU vector.
     """
-    a = anchors.shape[0]
     inside = (
         (anchors[:, 0] >= -allowed_border)
         & (anchors[:, 1] >= -allowed_border)
@@ -141,7 +148,6 @@ def assign_anchors(
     # Restricted to INSIDE anchors — the reference filters to inside anchors
     # before the gt-argmax step, so a gt near the border still gets its best
     # in-bounds anchor as a positive.
-    any_gt = jnp.any(gt_valid)
     iou_inside = iou * inside[:, None].astype(iou.dtype)
     gt_best = jnp.max(iou_inside, axis=0)  # (G,)
     # Exact == is safe here because the IoUs are snapped to a coarse grid:
@@ -150,18 +156,165 @@ def assign_anchors(
         (iou_inside == gt_best[None, :]) & gt_valid[None, :] & (gt_best[None, :] > 0.0),
         axis=1,
     )
-
-    fg_cand = inside & any_gt & ((max_iou >= positive_iou) | is_gt_best)
     in_ignore = _ignore_overlap_mask(anchors, gt_boxes, gt_ignore, ignore_ioa)
+    return inside, max_iou, argmax_gt, is_gt_best, in_ignore
+
+
+def _per_anchor_stats_blocked(
+    anchors, gt_boxes, gt_valid, gt_ignore,
+    image_height, image_width, allowed_border, ignore_ioa, block,
+):
+    """Tiled equivalent of :func:`_per_anchor_stats_dense` — bit-identical.
+
+    The (A, G) IoU matrix (34 MB at the 268k-anchor recipe canvas) never
+    materializes: a ``lax.scan`` over ``block``-anchor tiles computes each
+    tile's IoU in VMEM, reduces it to the per-anchor stats in the same
+    fusion, and carries only the (G,) per-gt running best.  A second
+    sweep recomputes each tile's IoU (arithmetically the exact same
+    elementwise values — ~86 MFLOP, noise) to test the snapped-IoU
+    equality against the now-final ``gt_best``.
+
+    Bitwise parity with the dense pass (asserted exactly in
+    tests/test_detection_middle.py): elementwise IoU/IoA/threshold math is identical
+    per anchor regardless of tiling, and f32 ``max`` is associative and
+    commutative exactly, so the blockwise per-gt maximum equals the
+    global one bit for bit.
+    """
+    a = anchors.shape[0]
+    nb = -(-a // block)
+    pad = nb * block - a
+    apad = (
+        jnp.concatenate([anchors, jnp.zeros((pad, 4), anchors.dtype)])
+        if pad
+        else anchors
+    )
+    tiles = apad.reshape(nb, block, 4)
+    real = (jnp.arange(nb * block) < a).reshape(nb, block)
+    gvf = gt_valid.astype(anchors.dtype)
+
+    def tile_stats(ab, rb):
+        inside = (
+            rb
+            & (ab[:, 0] >= -allowed_border)
+            & (ab[:, 1] >= -allowed_border)
+            & (ab[:, 2] < image_width + allowed_border)
+            & (ab[:, 3] < image_height + allowed_border)
+        )
+        iou = snap(iou_matrix(ab, gt_boxes)) * gvf[None, :]
+        return inside, iou * inside[:, None].astype(iou.dtype), iou
+
+    def pass1(gt_best, xs):
+        ab, rb = xs
+        inside, iou_inside, iou = tile_stats(ab, rb)
+        max_iou = jnp.max(iou, axis=1)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        gt_best = jnp.maximum(gt_best, jnp.max(iou_inside, axis=0))
+        if gt_ignore is None:
+            in_ignore = jnp.zeros(ab.shape[0], bool)
+        else:
+            ioa = snap(ioa_matrix(ab, gt_boxes)) * gt_ignore[None, :].astype(
+                ab.dtype
+            )
+            in_ignore = jnp.max(ioa, axis=1) >= ignore_ioa
+        return gt_best, (inside, max_iou, argmax_gt, in_ignore)
+
+    gt_best0 = jnp.zeros(gt_boxes.shape[0], anchors.dtype)
+    gt_best, (inside, max_iou, argmax_gt, in_ignore) = lax.scan(
+        pass1, gt_best0, (tiles, real)
+    )
+
+    def pass2(carry, xs):
+        ab, rb = xs
+        _, iou_inside, _ = tile_stats(ab, rb)
+        is_best = jnp.any(
+            (iou_inside == gt_best[None, :])
+            & gt_valid[None, :]
+            & (gt_best[None, :] > 0.0),
+            axis=1,
+        )
+        return carry, is_best
+
+    _, is_gt_best = lax.scan(pass2, 0, (tiles, real))
+
+    def flat(x):
+        return x.reshape(nb * block)[:a]
+
+    return (
+        flat(inside), flat(max_iou), flat(argmax_gt), flat(is_gt_best),
+        flat(in_ignore),
+    )
+
+
+def assign_anchors(
+    key: jax.Array,
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    image_height,
+    image_width,
+    batch_size: int = 256,
+    fg_fraction: float = 0.5,
+    positive_iou: float = 0.7,
+    negative_iou: float = 0.3,
+    allowed_border: float = 0.0,
+    gt_ignore: jnp.ndarray | None = None,
+    ignore_ioa: float = 0.5,
+    assign_block: int = 16384,
+    topk_block: int = 32768,
+) -> AnchorTargets:
+    """Label anchors for RPN training (reference assign_anchor semantics).
+
+    - anchors crossing the image boundary (by more than ``allowed_border``)
+      are ignored;
+    - fg: IoU >= positive_iou with some gt, PLUS every gt's best anchor
+      (so each gt gets at least one positive even below the threshold);
+    - bg: max IoU < negative_iou;
+    - subsample to ``batch_size`` with at most ``fg_fraction`` positives;
+      leftover fg quota is given to bg (reference behavior).
+
+    ``gt_boxes`` is padded to a static G with ``gt_valid`` masking; slots
+    flagged in ``gt_ignore`` (COCO crowd / VOC difficult) are never fg
+    matches, and anchors covering them (IoA >= ``ignore_ioa``) are excluded
+    from bg so crowds don't train as negatives.
+
+    ``assign_block`` > 0 tiles the anchor axis so the (A, G) IoU never
+    materializes (``_per_anchor_stats_blocked`` — bit-identical to the
+    dense pass, see its docstring); ``topk_block`` routes the two
+    subsampling top_k's through the blocked exact reduction.  0 disables
+    either (the original dense/global forms).
+    """
+    a = anchors.shape[0]
+    if assign_block and 0 < assign_block < a:
+        inside, max_iou, argmax_gt, is_gt_best, in_ignore = (
+            _per_anchor_stats_blocked(
+                anchors, gt_boxes, gt_valid, gt_ignore,
+                image_height, image_width, allowed_border, ignore_ioa,
+                assign_block,
+            )
+        )
+    else:
+        inside, max_iou, argmax_gt, is_gt_best, in_ignore = (
+            _per_anchor_stats_dense(
+                anchors, gt_boxes, gt_valid, gt_ignore,
+                image_height, image_width, allowed_border, ignore_ioa,
+            )
+        )
+
+    any_gt = jnp.any(gt_valid)
+    fg_cand = inside & any_gt & ((max_iou >= positive_iou) | is_gt_best)
     bg_cand = inside & (max_iou < negative_iou) & ~fg_cand & ~in_ignore
 
     num_fg_quota = int(batch_size * fg_fraction)
     k_fg, k_bg = jax.random.split(key)
     n_fg = jnp.minimum(num_fg_quota, jnp.sum(fg_cand))
-    fg = _select_random(k_fg, fg_cand, n_fg, num_fg_quota)
+    fg, fg_idx, fg_take = _select_random(
+        k_fg, fg_cand, n_fg, num_fg_quota, block=topk_block, with_indices=True
+    )
 
     n_bg = jnp.minimum(batch_size - n_fg, jnp.sum(bg_cand))
-    bg = _select_random(k_bg, bg_cand, n_bg, batch_size)
+    bg, bg_idx, bg_take = _select_random(
+        k_bg, bg_cand, n_bg, batch_size, block=topk_block, with_indices=True
+    )
 
     labels = jnp.full((a,), -1, dtype=jnp.int32)
     labels = jnp.where(bg, 0, labels)
@@ -176,6 +329,9 @@ def assign_anchors(
         bbox_targets=bbox_targets,
         fg_mask=fg,
         valid_mask=labels >= 0,
+        sel_idx=jnp.concatenate([fg_idx, bg_idx]).astype(jnp.int32),
+        sel_take=jnp.concatenate([fg_take, bg_take]),
+        sel_fg=jnp.concatenate([fg_take, jnp.zeros_like(bg_take)]),
     )
 
 

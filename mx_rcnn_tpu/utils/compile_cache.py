@@ -180,6 +180,61 @@ def cpu_fingerprint() -> str:
     return hashlib.sha1(key.encode()).hexdigest()[:8]
 
 
+def backend_fingerprint() -> str:
+    """Cache-key fingerprint for WHATEVER backend jax initialized.
+
+    - cpu: :func:`cpu_fingerprint` — XLA:CPU AOT blobs are codegen'd for
+      the compiling host's LLVM target features, so the key must separate
+      hosts (stale foreign blobs SIGILL or silently change numerics).
+    - tpu / gpu: hash of (backend, device_kind, platform_version, jaxlib).
+      Accelerator executables are keyed by chip generation and compiler
+      stack, not host CPU — a v5e blob must not be replayed on a v6e
+      (or across libtpu/XLA upgrades), which is exactly what a shared
+      un-keyed ``.jax_cache`` dir (bench.py pre-r5) allowed when a
+      checkout migrates between machines.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return cpu_fingerprint()
+    import jaxlib
+
+    dev = jax.devices()[0]
+    try:
+        import jax.extend.backend
+
+        platform_version = jax.extend.backend.get_backend().platform_version
+    except Exception:
+        platform_version = "?"
+    key = "\n".join(
+        [
+            "backend=" + backend,
+            "device_kind=" + getattr(dev, "device_kind", "?"),
+            "platform_version=" + platform_version,
+            "jaxlib=" + jaxlib.version.__version__,
+        ]
+    )
+    return backend + "-" + hashlib.sha1(key.encode()).hexdigest()[:8]
+
+
+def configure_cache(cache_root: str, min_compile_secs: float = 5.0) -> str:
+    """Point jax's persistent compile cache at a fingerprinted subdir.
+
+    Generalized form of :func:`configure_cpu_cache`: keys ``cache_root``
+    by :func:`backend_fingerprint` so one checkout shared across hosts /
+    chip generations never replays a foreign executable, with the same
+    keep-newest-3 sibling pruning.  Call after the backend is decided
+    (importing jax is fine; the first ``jax.devices()`` call here
+    initializes it).  Returns the directory used.
+    """
+    import jax
+
+    cache_dir = os.path.join(cache_root, backend_fingerprint())
+    _prune_and_point(jax, cache_root, cache_dir, min_compile_secs)
+    return cache_dir
+
+
 def configure_cpu_cache(repo_root: str) -> str:
     """Point jax's persistent compile cache at the shared fingerprinted dir.
 
@@ -190,6 +245,12 @@ def configure_cpu_cache(repo_root: str) -> str:
 
     cache_root = os.path.join(repo_root, "tests", ".jax_cache")
     cache_dir = os.path.join(cache_root, cpu_fingerprint())
+    _prune_and_point(jax, cache_root, cache_dir, 5.0)
+    return cache_dir
+
+
+def _prune_and_point(jax, cache_root: str, cache_dir: str,
+                     min_compile_secs: float) -> None:
     # Key rotations (host change, jaxlib upgrade) orphan old sibling dirs.
     # Builder hosts alternate between sessions on this shared checkout, so
     # deleting every foreign sibling would wipe another host's warm cache
@@ -216,6 +277,6 @@ def configure_cpu_cache(repo_root: str) -> str:
     except OSError:
         pass
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
     jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-    return cache_dir

@@ -55,6 +55,10 @@ COMPONENT_PATTERNS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("RPN-loss", ("rpn_loss",)),
     ("RCNN-loss", ("rcnn_loss",)),
     ("mask-loss", ("mask_loss",)),
+    # Before proposals/sampling: the hierarchical top-k scope nests inside
+    # both (proposal pre-NMS candidates, assign_anchors' _select_random),
+    # and first-match-wins gives it its own bucket for A/B attribution.
+    ("topk-hier", ("topk_hier",)),
     ("proposals", ("proposals",)),
     ("sampling", ("sample_rois", "assign_anchors")),
     ("preprocess", ("prep_images",)),

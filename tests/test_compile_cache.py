@@ -98,3 +98,63 @@ class TestBlobFallback:
             lambda: compile_cache._features_from_blob(b"other host bytes"),
         )
         assert compile_cache.cpu_fingerprint() != base
+
+
+class TestBackendFingerprint:
+    """The generalized key bench.py/perf_breakdown.py now use: same
+    SIGILL-proofing as the CPU-only key, but correct on accelerators too
+    (keyed by chip generation + compiler stack, not host CPU)."""
+
+    def test_cpu_backend_delegates_to_cpu_fingerprint(self):
+        # The suite runs on the fake-CPU backend, so the generalized key
+        # must be exactly the battle-tested CPU key.
+        assert compile_cache.backend_fingerprint() == compile_cache.cpu_fingerprint()
+
+    def test_accelerator_key_moves_with_device_kind(self, monkeypatch):
+        import jax
+
+        class _Dev:
+            device_kind = "TPU v5e"
+
+        class _Dev2:
+            device_kind = "TPU v6e"
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
+        a = compile_cache.backend_fingerprint()
+        monkeypatch.setattr(jax, "devices", lambda: [_Dev2()])
+        b = compile_cache.backend_fingerprint()
+        assert a.startswith("tpu-") and b.startswith("tpu-")
+        assert a != b  # a v5e blob must never be replayed on a v6e
+
+    def test_configure_cache_creates_keyed_subdir(self, tmp_path):
+        import jax
+
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            d = compile_cache.configure_cache(str(tmp_path))
+            assert d == str(tmp_path / compile_cache.backend_fingerprint())
+            assert jax.config.jax_compilation_cache_dir == d
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_configure_cache_prunes_stale_siblings(self, tmp_path):
+        import os
+        import time
+
+        import jax
+
+        # Four stale sibling dirs + ours: keep-3 prunes the oldest.
+        for i, name in enumerate(["aaa", "bbb", "ccc", "ddd"]):
+            p = tmp_path / name
+            p.mkdir()
+            t = time.time() - 1000 + i
+            os.utime(p, (t, t))
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            d = compile_cache.configure_cache(str(tmp_path))
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+        survivors = {q.name for q in tmp_path.iterdir()}
+        assert "aaa" not in survivors  # oldest pruned
+        assert os.path.basename(d) not in ("aaa",)

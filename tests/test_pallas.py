@@ -202,7 +202,7 @@ class TestPallasRoiAlign:
 
         g_ref = jax.grad(loss_ref)(pyr)
         fwd = multilevel_roi_align(pyr, rois, output_size=7, sampling_ratio=2)
-        g_pyr, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), 2.0 * fwd)
+        g_pyr, _ = pra._fast_bwd(7, 2, 48, True, "pallas", (pyr, rois), 2.0 * fwd)
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(g_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
@@ -251,7 +251,7 @@ class TestPallasRoiAlign:
 
         out_shape = (b, 8, 7, 7, pyr[2].shape[-1])
         g = jnp.ones(out_shape, jnp.float32)
-        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
+        grad_pyr, grad_rois = pra._fast_bwd(7, 2, 48, True, "pallas", (pyr, rois), g)
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(grad_pyr[l]), np.asarray(g_ref[l]), atol=1e-4
@@ -274,7 +274,8 @@ class TestPallasRoiAlign:
         from mx_rcnn_tpu.ops.pallas import roi_align as pra
 
         g_pyr, g_rois = pra._fast_bwd(
-            7, 2, 48, True, (pyr, rois), 2.0 * multilevel_roi_align(pyr, rois)
+            7, 2, 48, True, "pallas", (pyr, rois),
+            2.0 * multilevel_roi_align(pyr, rois)
         )
         for l in pyr:
             np.testing.assert_allclose(
@@ -293,9 +294,9 @@ class TestPallasRoiAlign:
         rois = _random_rois(rng, 8, canvas=128)
         g = multilevel_roi_align(pyr, rois)
         monkeypatch.setenv("MX_RCNN_POOL_BWD", "xla")
-        g_xla, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
+        g_xla, _ = pra._fast_bwd(7, 2, 48, True, "pallas", (pyr, rois), g)
         monkeypatch.delenv("MX_RCNN_POOL_BWD")
-        g_pal, _ = pra._fast_bwd(7, 2, 48, True, (pyr, rois), g)
+        g_pal, _ = pra._fast_bwd(7, 2, 48, True, "pallas", (pyr, rois), g)
         for l in pyr:
             np.testing.assert_allclose(
                 np.asarray(g_xla[l]), np.asarray(g_pal[l]), atol=1e-4

@@ -21,7 +21,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -30,48 +29,23 @@ import jax.numpy as jnp
 import numpy as np
 
 # Same persistent compile cache as bench.py: iterating on one stage should
-# not recompile the other seven.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+# not recompile the other seven.  Fingerprinted subdir (backend + host
+# features): an un-keyed dir on a checkout that migrates between machines
+# replays foreign XLA:CPU AOT blobs — SIGILL risk (MULTICHIP_r0* tails).
+from mx_rcnn_tpu.utils.compile_cache import configure_cache
+from mx_rcnn_tpu.utils.stage_bench import (  # noqa: F401  (timed: re-export)
+    time_train_stages,
+    timed,
+    train_stage_fns,
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
 
-
-def timed(fn, arg, n, calls=3, extra=None):
-    """Time n dependency-chained executions of ``fn`` per device call.
-
-    The chain lives INSIDE a ``lax.scan`` (one dispatch per n steps): each
-    scan iteration perturbs the carry with 0 * the step's output, so step
-    i+1 provably depends on step i and the single final fetch waits for the
-    whole chain (BASELINE.md timing rule).  Per-step dispatch timing is
-    untrustworthy here — through the axon tunnel one dispatch costs ~25 ms,
-    more than most stages' device compute, which is exactly why bench.py
-    uses a scanned step loop; this tool must match it or the per-stage
-    numbers drown in tunnel overhead (r3 finding: the unscanned version
-    read 159 ms for a stage the scanned version reads ~60 ms).
-
-    ``extra``: a pytree of large scan-invariant inputs (feature maps,
-    params) passed as a jit ARGUMENT — closing over device arrays would
-    embed them as HLO constants in the remote-compile request (the
-    tunnel's request-size limit killed exactly that in bench.py)."""
-
-    def chain(carry, ex):
-        def body(c, _):
-            out = fn(c) if ex is None else fn(c, ex)
-            c2 = jax.tree_util.tree_map(lambda x, g: x + 0.0 * g, c, out)
-            return c2, ()
-
-        return jax.lax.scan(body, carry, None, length=n)[0]
-
-    chained = jax.jit(chain)
-    carry = chained(arg, extra)  # compile + warm
-    jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        carry = chained(carry, extra)
-    jax.device_get(jax.tree_util.tree_leaves(carry)[0].ravel()[0])
-    return (time.perf_counter() - t0) / (n * calls)
+configure_cache(
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+    min_compile_secs=10,
+)
 
 
 def main() -> None:
@@ -117,17 +91,8 @@ def main() -> None:
     args = ap.parse_args()
 
     from mx_rcnn_tpu.config import apply_overrides, get_config
-    from mx_rcnn_tpu.detection import Batch, TwoStageDetector, forward_train
-    from mx_rcnn_tpu.detection.graph import (
-        _pool_rois,
-        _propose_one,
-        _rpn_losses,
-        _slice_levels,
-        assign_anchors_cfg,
-        init_detector,
-        level_anchors,
-    )
-    from mx_rcnn_tpu.ops import sample_rois
+    from mx_rcnn_tpu.detection import Batch, TwoStageDetector
+    from mx_rcnn_tpu.detection.graph import init_detector, level_anchors
 
     if "x" in args.hw:
         h, w = (int(t) for t in args.hw.split("x"))
@@ -187,104 +152,19 @@ def main() -> None:
         def masked(p):
             return p
 
-    # Shared front end (mirrors forward_train's structure).  Each stage is
-    # "everything before it" + one more piece; all stages keep the RPN loss
-    # term so the backbone backward exists in every variant (in the real
-    # graph proposals/sampling are stop-grad side computations).
-    def front(p, upto: str):
-        v = {"params": masked(p), **rest}
-        feats = model.apply(v, batch.images, method="features")
-        if upto == "backbone":
-            return sum(jnp.sum(f.astype(jnp.float32) ** 2) for f in feats.values())
-        rpn_out = model.apply(v, feats, method="rpn")
-        anchors = level_anchors(mcfg, feats)
-        levels = sorted(rpn_out)
-        logits = jnp.concatenate([rpn_out[l][0] for l in levels], axis=1)
-        deltas = jnp.concatenate([rpn_out[l][1] for l in levels], axis=1)
-        if upto == "rpn":
-            return sum(
-                jnp.sum(o.astype(jnp.float32) ** 2)
-                for pair in rpn_out.values() for o in pair
-            )
-        anchors_cat = jnp.concatenate([anchors[l] for l in levels], axis=0)
-        targets = jax.vmap(
-            lambda k, gt, gv, hw_: assign_anchors_cfg(
-                mcfg, k, anchors_cat, gt, gv, hw_[0], hw_[1]
-            )
-        )(jax.random.split(key, b), batch.gt_boxes, batch.gt_valid, batch.image_hw)
-        rpn_cls, rpn_box, _ = _rpn_losses(logits, deltas, targets)
-        loss = rpn_cls + rpn_box
-        if upto == "rpnloss":
-            return loss
-        scores = jax.nn.sigmoid(jax.lax.stop_gradient(logits))
-        propose = _propose_one(mcfg, train=True)
-        props = jax.vmap(
-            lambda s, d, hw_: propose(*_slice_levels(levels, anchors, s, d), hw_)
-        )(scores, jax.lax.stop_gradient(deltas), batch.image_hw)
-        if upto == "proposals":
-            return loss + (jnp.sum(props.rois) + jnp.sum(props.scores)) * 1e-30
-        samples = jax.vmap(
-            lambda k, rois, rv, gt, gc, gv: sample_rois(
-                k, rois, rv, gt, gc, gv,
-                batch_size=mcfg.rcnn.roi_batch_size,
-                fg_fraction=mcfg.rcnn.fg_fraction,
-                fg_iou=mcfg.rcnn.fg_iou,
-                bg_iou_hi=mcfg.rcnn.bg_iou_hi,
-                bg_iou_lo=mcfg.rcnn.bg_iou_lo,
-                bbox_weights=mcfg.rcnn.bbox_weights,
-            )
-        )(jax.random.split(key, b), props.rois, props.valid, batch.gt_boxes,
-          batch.gt_classes, batch.gt_valid)
-        if upto == "sample":
-            return loss + jnp.sum(samples.rois) * 1e-30
-        if upto == "pool_fwd":
-            # Forward-only pooling: cut the feature cotangent so the delta
-            # vs "sample" isolates the kernel FORWARD in-graph, and the
-            # "pool" - "pool_fwd" gap isolates backward + the cost of
-            # merging a second cotangent into the shared trunk backward.
-            pooled = _pool_rois(
-                mcfg,
-                jax.tree_util.tree_map(jax.lax.stop_gradient, feats),
-                samples.rois, mcfg.rcnn.pooled_size, model.roi_levels,
-            )
-            return loss + jnp.sum(pooled.astype(jnp.float32) ** 2) * 1e-30
-        pooled = _pool_rois(
-            mcfg, feats, samples.rois, mcfg.rcnn.pooled_size, model.roi_levels
-        )
-        if upto == "pool":
-            return loss + jnp.sum(pooled.astype(jnp.float32) ** 2) * 1e-30
-        raise ValueError(upto)
-
-    def stage_full(p):
-        loss, _ = forward_train(model, {"params": masked(p), **rest}, key, batch)
-        return loss
-
-    stages = [
-        ("backbone fwd+bwd", lambda p: front(p, "backbone")),
-        ("+rpn head", lambda p: front(p, "rpn")),
-        ("+assign+rpn losses", lambda p: front(p, "rpnloss")),
-        ("+proposal gen (stop-grad)", lambda p: front(p, "proposals")),
-        ("+sample_rois (stop-grad)", lambda p: front(p, "sample")),
-        ("+roialign fwd only", lambda p: front(p, "pool_fwd")),
-        ("+roialign fwd+bwd", lambda p: front(p, "pool")),
-        ("full forward_train+bwd", stage_full),
-    ]
+    # Stage list shared with bench.py --breakdown
+    # (mx_rcnn_tpu/utils/stage_bench.py): each stage is "everything before
+    # it" + one more piece of forward_train; all keep the RPN loss term so
+    # the backbone backward exists in every variant.
+    stages = train_stage_fns(model, params, rest, batch, key, masked=masked)
     if args.only:
         stages = [s for s in stages if args.only in s[0]]
-    results = []
-    for name, fn in stages:
-        def grad_plus(p, fn=fn):
-            # value_and_grad with the VALUE folded into the output:
-            # value-only side branches (the pool_fwd stage's stop-grad
-            # pooling) otherwise get DCE'd under jax.grad and time as 0.
-            val, g = jax.value_and_grad(fn)(p)
-            return jax.tree_util.tree_map(
-                lambda x: x + 0.0 * val.astype(x.dtype), g
-            )
-
-        dt = timed(jax.jit(grad_plus), params, args.steps)
-        results.append((name, dt))
-        print(f"{name:32s} {dt * 1e3:8.2f} ms/step", flush=True)
+    results = time_train_stages(
+        stages, params, args.steps,
+        report=lambda name, dt: print(
+            f"{name:32s} {dt * 1e3:8.2f} ms/step", flush=True
+        ),
+    )
 
     if args.only:
         _print_deltas(results, filtered=True)
