@@ -39,6 +39,7 @@ from mx_rcnn_tpu.analysis.baseline import (
     write_baseline,
 )
 from mx_rcnn_tpu.analysis.jaxpr_checks import (
+    UPCAST_ALLOWLIST,
     CheckResult,
     build_programs,
     run_jaxpr_checks,
@@ -55,6 +56,7 @@ __all__ = [
     "load_baseline",
     "new_findings",
     "write_baseline",
+    "UPCAST_ALLOWLIST",
     "CheckResult",
     "build_programs",
     "run_jaxpr_checks",

@@ -30,11 +30,23 @@ Invariants (no suppression mechanism — these must hold outright):
                       a named component (utils/hlo_profile.py), so the
                       per-component MFU report has no silent "other"
                       bucket.
+* ``no_f32_upcast``  — (TPU006) a bf16-mixed variant of the train step
+                      (``model.backbone.dtype=bfloat16`` +
+                      ``model.precision.policy=mixed``) carries no
+                      bf16->f32 ``convert_element_type`` outside the
+                      accumulation allowlist (:data:`UPCAST_ALLOWLIST`)
+                      or the backward pass.  This is the un-rot guard
+                      for the r6 mixed-precision win: one stray
+                      ``.astype(jnp.float32)`` on a head output or a
+                      score lane silently re-materializes the (B, ~268k)
+                      detection middle in f32, and nothing else would
+                      notice.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional
 
 ATTRIBUTION_MIN_PCT = 99.0
@@ -292,11 +304,116 @@ def check_flop_attribution(programs: Programs) -> CheckResult:
     )
 
 
+# ---------------------------------------------------------------------------
+# TPU006: no accidental f32 upcast on the bf16 hot path
+
+
+# Name-stack tokens under which a bf16->f32 convert is an ACCUMULATION
+# entry, not a leak: losses, sampling/assignment (IoU vs f32 gt boxes),
+# proposal decode (f32 anchors/coords — see utils/precision.py's box-
+# coordinate note), ROI Align (f32 bilinear weights from f32 roi coords
+# and an f32 per-bin sample accumulator, downcast ONCE to the feature
+# dtype on exit — ops/roi_align.py), the guardian finiteness reduction,
+# and the optimizer.  The backward pass is allowed wholesale via its
+# "transpose(...)" stack frames: jax.grad of an f32 param used in bf16
+# compute accumulates the gradient back to f32 through the transpose of
+# the param cast — that convert IS the f32-master-gradient contract, not
+# a leak.
+UPCAST_ALLOWLIST = (
+    "rpn_loss",
+    "rcnn_loss",
+    "mask_loss",
+    "guardian",
+    "optimizer",
+    "proposals",
+    "sample_rois",
+    "assign_anchors",
+    "roi_align",
+)
+
+_BF16_OVERRIDES = (
+    "model.backbone.dtype=bfloat16",
+    "model.precision.policy=mixed",
+)
+
+
+@functools.lru_cache(maxsize=2)
+def _bf16_train_jaxpr(config_name: str):
+    """Traced jaxpr of the train step under the bf16 "mixed" policy.
+
+    The shared ``Programs`` trace the preset as-is — for tiny_synthetic
+    (f32 backbone) the mixed policy degenerates to all-f32 and an upcast
+    scan would be vacuous — so TPU006 traces its own bf16 variant.
+    Memoized: the trace is the expensive part and both the CLI and the
+    test suite call this."""
+    import jax
+
+    from bench import _synthetic_batch
+    from mx_rcnn_tpu.config import apply_overrides, get_config
+    from mx_rcnn_tpu.train.loop import build_all
+
+    cfg = apply_overrides(get_config(config_name), list(_BF16_OVERRIDES))
+    _model, _tx, state, train_step, _gb = build_all(cfg, mesh=None)
+    k = max(cfg.train.steps_per_call, 1)
+    batch = _synthetic_batch(
+        cfg, cfg.train.per_device_batch, cfg.data.image_size, k
+    )
+    return jax.make_jaxpr(train_step)(state, batch)
+
+
+def _walk_upcasts(jaxpr, prefix: str, bad: list[str], total: list[int]) -> None:
+    for eqn in jaxpr.eqns:
+        stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+        full = "/".join(s for s in (prefix, stack) if s)
+        if eqn.primitive.name == "convert_element_type":
+            in_dt = str(getattr(eqn.invars[0].aval, "dtype", ""))
+            out_dt = str(getattr(eqn.outvars[0].aval, "dtype", ""))
+            if in_dt == "bfloat16" and out_dt == "float32":
+                total[0] += 1
+                if "transpose(" not in full and not any(
+                    tok in full for tok in UPCAST_ALLOWLIST
+                ):
+                    bad.append(full or "<no name stack>")
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "body_jaxpr",
+                    "cond_jaxpr"):
+            sub = eqn.params.get(key)
+            if sub is not None:
+                _walk_upcasts(
+                    sub.jaxpr if hasattr(sub, "jaxpr") else sub,
+                    full, bad, total,
+                )
+        for br in eqn.params.get("branches", ()):
+            _walk_upcasts(br.jaxpr, full, bad, total)
+
+
+def check_no_f32_upcast(programs: Programs) -> CheckResult:
+    """TPU006: every bf16->f32 convert in the bf16-mixed train step sits
+    under an allowlisted accumulation scope or the backward pass."""
+    closed = _bf16_train_jaxpr(programs.config_name)
+    bad: list[str] = []
+    total = [0]
+    _walk_upcasts(closed.jaxpr, "", bad, total)
+    if bad:
+        sample = sorted(set(bad))[:8]
+        return CheckResult(
+            "no_f32_upcast", False,
+            f"{len(bad)} bf16->f32 convert(s) outside the accumulation "
+            f"allowlist {UPCAST_ALLOWLIST} in the bf16-mixed train step; "
+            "name stacks: " + "; ".join(s[:90] for s in sample),
+        )
+    return CheckResult(
+        "no_f32_upcast", True,
+        f"all {total[0]} bf16->f32 converts in the bf16-mixed train step "
+        "sit under allowlisted accumulation scopes or the backward pass",
+    )
+
+
 ALL_CHECKS = (
     check_no_x64,
     check_trace_deterministic,
     check_donation,
     check_flop_attribution,
+    check_no_f32_upcast,
     check_transfer_guard,   # last: the only one that executes the programs
 )
 

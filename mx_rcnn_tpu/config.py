@@ -237,6 +237,36 @@ class TestConfig:
 
 
 @dataclass(frozen=True)
+class PrecisionConfig:
+    """End-to-end mixed-precision policy (utils/precision.py resolves it).
+
+    ``policy`` names the whole-graph dtype contract:
+
+    - ``"mixed"`` (default): heads compute AND emit in the backbone's
+      compute dtype — with a bfloat16 backbone nothing f32-sized crosses
+      the model/detection boundary (the (B, ~268k) RPN logit and
+      (B, ~268k, 4) delta materializations were the last ones).  Losses,
+      metrics, the guardian reduction, and the optimizer still accumulate
+      in float32 (the explicit upcast allowlist tpulint TPU006 enforces),
+      and box *coordinates* stay float32 throughout — only scores/logits
+      ride bf16.  With a float32 backbone (tiny_synthetic) this resolves
+      to all-f32 and is bit-identical to historical graphs.
+    - ``"widen"``: heads compute in the backbone dtype but cast outputs
+      to float32 — exactly the pre-r6 graphs, kept as the A/B and
+      bisection escape hatch.
+    - ``"float32"``: force everything float32 regardless of the backbone
+      dtype knob.
+
+    ``accum`` is the accumulation dtype for losses/metrics/reductions;
+    anything other than float32 voids the TPU006 contract and the NaN
+    guardian's assumptions — it exists for experiments, not recipes.
+    """
+
+    policy: str = "mixed"  # mixed | widen | float32
+    accum: str = "float32"
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     num_classes: int = 81  # includes background at index 0 (COCO: 80 + 1)
     backbone: BackboneConfig = field(default_factory=BackboneConfig)
@@ -246,6 +276,7 @@ class ModelConfig:
     rcnn: RCNNConfig = field(default_factory=RCNNConfig)
     mask: MaskConfig = field(default_factory=MaskConfig)
     test: TestConfig = field(default_factory=TestConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
 
 
 @dataclass(frozen=True)

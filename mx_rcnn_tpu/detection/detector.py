@@ -16,9 +16,10 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from mx_rcnn_tpu.config import ModelConfig
-from mx_rcnn_tpu.models.build import _DTYPES, build_backbone
+from mx_rcnn_tpu.models.build import build_backbone
 from mx_rcnn_tpu.models.fpn import FPN
 from mx_rcnn_tpu.models.heads import BoxHead, MaskHead, RPNHead
+from mx_rcnn_tpu.utils.precision import policy_of
 
 
 class TwoStageDetector(nn.Module):
@@ -40,9 +41,18 @@ class TwoStageDetector(nn.Module):
 
     def setup(self):
         cfg = self.cfg
-        dtype = _DTYPES[cfg.backbone.dtype]
+        # The resolved mixed-precision policy (utils/precision.py) owns
+        # every head dtype: compute_dtype for conv/matmul, output_dtype
+        # for what crosses into the detection middle.  Under "widen" /
+        # float32 backbones this reproduces the historical graphs
+        # bitwise; under "mixed" the heads stop upcasting their outputs.
+        policy = policy_of(cfg)
+        dtype = policy.compute_dtype
+        out_dtype = policy.output_dtype
         backbone_levels = (2, 3, 4, 5) if cfg.fpn.enabled else (4,)
-        self.backbone = build_backbone(cfg.backbone, out_levels=backbone_levels)
+        self.backbone = build_backbone(
+            cfg.backbone, out_levels=backbone_levels, dtype=dtype
+        )
         if cfg.fpn.enabled:
             self.fpn = FPN(
                 channels=cfg.fpn.channels,
@@ -55,6 +65,7 @@ class TwoStageDetector(nn.Module):
             num_anchors=cfg.anchors.num_anchors(),
             channels=cfg.rpn.channels,
             dtype=dtype,
+            out_dtype=out_dtype,
             name="rpn",
         )
         self.box_head = BoxHead(
@@ -62,6 +73,7 @@ class TwoStageDetector(nn.Module):
             hidden_dim=cfg.rcnn.hidden_dim,
             class_agnostic=cfg.rcnn.class_agnostic,
             dtype=dtype,
+            out_dtype=out_dtype,
             name="box_head",
         )
         if cfg.mask.enabled:
@@ -70,6 +82,7 @@ class TwoStageDetector(nn.Module):
                 channels=cfg.mask.channels,
                 num_convs=cfg.mask.num_convs,
                 dtype=dtype,
+                out_dtype=out_dtype,
                 name="mask_head",
             )
 

@@ -150,6 +150,13 @@ def _rpn_losses(rpn_logits, rpn_deltas, targets, loss_impl: str = "dense"):
 
 
 def _rpn_losses_impl(rpn_logits, rpn_deltas, targets):
+    # Accumulation-precision entry (mixed policy: the head emits bf16).
+    # The upcast happens HERE, inside the rpn_loss named scope — the
+    # tpulint TPU006 allowlist — so loss sums always run in f32.  No-op
+    # on f32 inputs.  The dense form pays a (B, A) f32 materialization;
+    # the compact form below upcasts after the Q-row gather instead.
+    rpn_logits = rpn_logits.astype(jnp.float32)
+    rpn_deltas = rpn_deltas.astype(jnp.float32)
     labels = targets.labels            # (B, A) 1/0/-1
     valid = targets.valid_mask         # (B, A)
     fg = targets.fg_mask               # (B, A)
@@ -188,12 +195,15 @@ def _rpn_losses_compact(rpn_logits, rpn_deltas, targets):
     count and matches the dense value exactly.
     """
     idx = targets.sel_idx              # (B, Q)
-    take = targets.sel_take.astype(rpn_logits.dtype)
+    take = targets.sel_take.astype(jnp.float32)
     is_fg = targets.sel_fg             # (B, Q)
     n_valid = jnp.maximum(jnp.sum(take), 1.0)
 
+    # Gather in the head's output dtype, upcast only the Q selected rows
+    # (accumulation allowlist: we are inside the rpn_loss named scope).
     logit_sel = jnp.take_along_axis(rpn_logits, idx, axis=1)      # (B, Q)
-    fgf = is_fg.astype(rpn_logits.dtype)
+    logit_sel = logit_sel.astype(jnp.float32)
+    fgf = is_fg.astype(jnp.float32)
     bce = -(
         fgf * jax.nn.log_sigmoid(logit_sel)
         + (1.0 - fgf) * jax.nn.log_sigmoid(-logit_sel)
@@ -201,6 +211,7 @@ def _rpn_losses_compact(rpn_logits, rpn_deltas, targets):
     cls_loss = jnp.sum(bce * take) / n_valid
 
     deltas_sel = jnp.take_along_axis(rpn_deltas, idx[..., None], axis=1)
+    deltas_sel = deltas_sel.astype(jnp.float32)
     targets_sel = jnp.take_along_axis(targets.bbox_targets, idx[..., None], axis=1)
     box_loss = weighted_smooth_l1(
         deltas_sel,
@@ -228,6 +239,10 @@ def _rcnn_losses(cls_logits, box_deltas, samples, class_agnostic: bool):
 
 
 def _rcnn_losses_impl(cls_logits, box_deltas, samples, class_agnostic: bool):
+    # Accumulation-precision entry (see _rpn_losses_impl): N = B*roi_batch
+    # rows only, upcast inside the rcnn_loss named scope.
+    cls_logits = cls_logits.astype(jnp.float32)
+    box_deltas = box_deltas.astype(jnp.float32)
     labels = samples.labels.reshape(-1)            # (N,)
     weights = samples.label_weights.reshape(-1)    # (N,)
     fg = samples.fg_mask.reshape(-1)               # (N,)
@@ -713,13 +728,20 @@ def assign_anchors_cfg(cfg: ModelConfig, key, anchors, gt, gv, h, w, gt_ignore=N
 
 
 def forward_inference(model: TwoStageDetector, variables, batch: Batch,
-                      mesh=None, pixel_stats=None) -> Detections:
+                      mesh=None, pixel_stats=None,
+                      box_head_apply=None) -> Detections:
     """Full inference: proposals -> box head -> per-class NMS -> top-D.
 
     Replaces ``rcnn/core/tester.py::im_detect`` + the per-class python NMS
     loop in ``pred_eval`` with one jitted region; detections come back
     padded to ``cfg.test.max_detections`` with a validity mask.  ``mesh``/
     ``pixel_stats``: see :func:`forward_train`.
+
+    ``box_head_apply``: optional drop-in for the box-head apply —
+    ``f(pooled_flat) -> (cls_logits (R, C), box_deltas (R, n_reg, 4))``,
+    the exact :class:`~mx_rcnn_tpu.models.heads.BoxHead` contract.  The
+    int8/bf16 serving program (serve/quantize.py) injects here; the rest
+    of the graph (backbone, RPN, pooling, postprocess) is shared.
     """
     cfg = model.cfg
     feats = model.apply(
@@ -744,12 +766,23 @@ def forward_inference(model: TwoStageDetector, variables, batch: Batch,
     )
     s = cfg.rcnn.pooled_size
     pooled_flat = pooled.reshape(-1, s, s, pooled.shape[-1])
-    cls_logits, box_deltas = model.apply(variables, pooled_flat, method="box")
+    if box_head_apply is None:
+        cls_logits, box_deltas = model.apply(
+            variables, pooled_flat, method="box"
+        )
+    else:
+        cls_logits, box_deltas = box_head_apply(pooled_flat)
 
     b, r = props.rois.shape[:2]
     num_classes = cfg.num_classes
-    cls_prob = jax.nn.softmax(cls_logits, axis=-1).reshape(b, r, num_classes)
-    box_deltas = box_deltas.reshape(b, r, -1, 4)
+    # Scores and box coordinates stay f32 through postprocess regardless
+    # of the head's output dtype: the softmax/decode operands here are
+    # (B*R, C)-sized — trivial next to the backbone — and f32 scores keep
+    # ranking/threshold behavior identical across precision policies.
+    cls_prob = jax.nn.softmax(
+        cls_logits.astype(jnp.float32), axis=-1
+    ).reshape(b, r, num_classes)
+    box_deltas = box_deltas.astype(jnp.float32).reshape(b, r, -1, 4)
 
     if cfg.test.nms_mode == "fused":
         post_one = _postprocess_one_fused
@@ -812,7 +845,10 @@ def forward_proposals(model: TwoStageDetector, variables, batch: Batch,
     feats = model.apply(
         variables, prep_images(batch.images, pixel_stats), method="features"
     )
-    return _propose_on_features(model, variables, feats, batch)
+    props = _propose_on_features(model, variables, feats, batch)
+    # Proposal scores cross into host numpy on the serving/RPN-dump paths;
+    # emit f32 however the head computed them ((B, post_nms) — tiny).
+    return props._replace(scores=props.scores.astype(jnp.float32))
 
 
 def _postprocess_one(cfg: ModelConfig, rois, roi_valid, probs, deltas, hw):

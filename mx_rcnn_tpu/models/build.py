@@ -12,8 +12,15 @@ from mx_rcnn_tpu.models.vgg import VGG16
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
 
 
-def build_backbone(cfg: BackboneConfig, out_levels: tuple[int, ...] = (2, 3, 4, 5)) -> nn.Module:
-    dtype = _DTYPES[cfg.dtype]
+def build_backbone(
+    cfg: BackboneConfig,
+    out_levels: tuple[int, ...] = (2, 3, 4, 5),
+    dtype: jnp.dtype | None = None,
+) -> nn.Module:
+    """``dtype`` overrides the config knob — the detector passes the
+    resolved precision policy's compute dtype so a ``"float32"`` policy
+    really forces the whole model to f32, backbone included."""
+    dtype = _DTYPES[cfg.dtype] if dtype is None else dtype
     if cfg.name in STAGE_BLOCKS:
         return ResNet(blocks=STAGE_BLOCKS[cfg.name], norm=cfg.norm, dtype=dtype,
                       out_levels=out_levels, remat=cfg.remat,

@@ -49,6 +49,11 @@ class RPNHead(nn.Module):
     num_anchors: int
     channels: int = 256
     dtype: jnp.dtype = jnp.bfloat16
+    # Dtype the head EMITS across the model/detection boundary.  f32 (the
+    # historical "widen" contract) or the compute dtype (the "mixed"
+    # policy — utils/precision.py); the detector wires it from the
+    # resolved policy so heads never hard-code an upcast.
+    out_dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         self.conv = nn.Conv(self.channels, (3, 3), padding=[(1, 1), (1, 1)],
@@ -71,8 +76,8 @@ class RPNHead(nn.Module):
         logits, deltas = self._heads(x)
         b = x.shape[0]
         return (
-            logits.reshape(b, -1).astype(jnp.float32),
-            deltas.reshape(b, -1, 4).astype(jnp.float32),
+            logits.reshape(b, -1).astype(self.out_dtype),
+            deltas.reshape(b, -1, 4).astype(self.out_dtype),
         )
 
     def packed(
@@ -103,8 +108,8 @@ class RPNHead(nn.Module):
             h, w = feats[lvl].shape[1], feats[lvl].shape[2]
             r0 = offsets[lvl]
             out[lvl] = (
-                logits[:, r0:r0 + h, :w, :].reshape(b, -1).astype(jnp.float32),
-                deltas[:, r0:r0 + h, :w, :].reshape(b, -1, 4).astype(jnp.float32),
+                logits[:, r0:r0 + h, :w, :].reshape(b, -1).astype(self.out_dtype),
+                deltas[:, r0:r0 + h, :w, :].reshape(b, -1, 4).astype(self.out_dtype),
             )
         return out
 
@@ -114,6 +119,7 @@ class BoxHead(nn.Module):
     hidden_dim: int = 1024
     class_agnostic: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    out_dtype: jnp.dtype = jnp.float32  # see RPNHead.out_dtype
 
     @nn.compact
     def __call__(self, rois: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -129,8 +135,8 @@ class BoxHead(nn.Module):
         deltas = nn.Dense(n_reg * 4, dtype=self.dtype,
                           kernel_init=_init001, name="bbox_pred")(x)
         return (
-            logits.astype(jnp.float32),
-            deltas.reshape(r, n_reg, 4).astype(jnp.float32),
+            logits.astype(self.out_dtype),
+            deltas.reshape(r, n_reg, 4).astype(self.out_dtype),
         )
 
 
@@ -139,6 +145,7 @@ class MaskHead(nn.Module):
     channels: int = 256
     num_convs: int = 4
     dtype: jnp.dtype = jnp.bfloat16
+    out_dtype: jnp.dtype = jnp.float32  # see RPNHead.out_dtype
 
     @nn.compact
     def __call__(self, rois: jnp.ndarray) -> jnp.ndarray:
@@ -155,4 +162,4 @@ class MaskHead(nn.Module):
         x = nn.relu(x)
         x = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype,
                     kernel_init=_init01, name="mask_logits")(x)
-        return x.astype(jnp.float32)
+        return x.astype(self.out_dtype)

@@ -1,14 +1,30 @@
 """Async host→device batch prefetch.
 
-``jax.device_put`` is asynchronous: issuing the transfer for batch k+1
-while batch k's step runs hides the PCIe/ICI copy behind compute (the
-reference relies on MXNet's threaded DataIter + engine for the same
-overlap).  Keeping ``depth`` batches in flight bounds device memory.
+Two overlaps, two mechanisms:
+
+- **Transfer overlap** — ``jax.device_put`` is asynchronous: issuing the
+  transfer for batch k+1 while batch k's step runs hides the PCIe/ICI
+  copy behind compute (the reference relies on MXNet's threaded DataIter
+  + engine for the same overlap).  Keeping ``depth`` batches in flight
+  bounds device memory.
+- **Host-work overlap** — a plain generator pipeline still runs the host
+  loader (decode, augment, letterbox, ``np.stack``) *synchronously in
+  the consumer's thread* between steps: the device sits idle for exactly
+  the loader's per-batch CPU time.  ``_HostPrefetcher`` moves the
+  ``next(it)`` calls to a background thread with a bounded handoff queue
+  (``host_depth`` batches read ahead — the one-step double buffer), so
+  loader time overlaps device time instead of serializing with it.
+
+Batch ORDER is unchanged by both (single producer, single consumer,
+FIFO queue), so schedule determinism — quarantine substitution, chaos
+bit-exact resume — is preserved.
 """
 
 from __future__ import annotations
 
 import collections
+import queue
+import threading
 from typing import Iterator, Optional
 
 import jax
@@ -16,23 +32,99 @@ import jax
 from mx_rcnn_tpu.parallel.mesh import shard_batch
 
 
+class _HostPrefetcher:
+    """Background-thread stage: pulls from ``it`` ahead of the consumer.
+
+    Exceptions raised by the source iterator are re-raised in the
+    consumer at the position they occurred (the failure is part of the
+    stream, not swallowed in the thread).  ``close()`` stops the thread
+    promptly even if it is blocked on a full queue; iterating a closed
+    prefetcher raises StopIteration.
+    """
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, depth: int = 1):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(it,), name="host-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for item in it:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put((item, None), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            payload = (self._DONE, None)
+        except BaseException as exc:  # noqa: BLE001 — relayed to consumer
+            payload = (self._DONE, exc)
+        while not self._stop.is_set():
+            try:
+                self._q.put(payload, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "_HostPrefetcher":
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item, exc = self._q.get()
+        if item is self._DONE:
+            self._stop.set()
+            if exc is not None:
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Drain so a producer blocked on put() observes the stop event.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+
 def device_prefetch(
     it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2,
-    spatial: bool = False, stacked: bool = False,
+    spatial: bool = False, stacked: bool = False, host_depth: int = 1,
 ) -> Iterator:
     """Wrap a host batch iterator: batches come out device-resident (sharded
     over the mesh when given), ``depth`` transfers ahead of consumption.
-    ``stacked``: batches carry a leading steps-per-call axis (K, B, ...)."""
+    ``stacked``: batches carry a leading steps-per-call axis (K, B, ...).
+    ``host_depth``: batches the background host-prefetch thread reads
+    ahead of the device_put stage (0 = synchronous pulls in the consumer
+    thread — the pre-r6 behavior, kept for strictly single-threaded
+    debugging).  Closing the returned generator (``gen.close()``) stops
+    the thread."""
     q: collections.deque = collections.deque()
+    src: Iterator = it if host_depth <= 0 else _HostPrefetcher(it, host_depth)
 
     def put(batch):
         if mesh is not None:
             return shard_batch(batch, mesh, spatial=spatial, stacked=stacked)
         return jax.device_put(batch)
 
-    for batch in it:
-        q.append(put(batch))
-        if len(q) > depth:
+    try:
+        for batch in src:
+            q.append(put(batch))
+            if len(q) > depth:
+                yield q.popleft()
+        while q:
             yield q.popleft()
-    while q:
-        yield q.popleft()
+    finally:
+        if isinstance(src, _HostPrefetcher):
+            src.close()

@@ -112,7 +112,13 @@ def make_train_step(
         # because `step` keys everything off state.step.
         new_state, mets = jax.lax.scan(step, state, batches)
         # Per-call metrics: mean over the K steps (lr: the last step's).
-        metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), mets)
+        # The f32 cast is the metric-accumulation contract (a no-op today
+        # — every loss/metric upcasts inside its accumulation scope — but
+        # it pins the K-step mean to f32 even if a future metric leaf
+        # arrives in bf16).
+        metrics = jax.tree_util.tree_map(
+            lambda m: jnp.mean(m.astype(jnp.float32), axis=0), mets
+        )
         if schedule is not None:
             metrics["lr"] = mets["lr"][-1]
         return new_state, metrics

@@ -8,6 +8,9 @@ cannot meet, or a circuit breaker opened by repeated full-path failures —
 requests step DOWN this ladder instead of timing out or queueing forever:
 
     full  >  small (full quality at a smaller resolution bucket)
+          >  full_q8 (int8/bf16 box head — serve/quantize.py; near-full
+                      quality, cheaper head; present when the runner was
+                      built with ``int8_head=True``)
           >  reduced (fewer max detections)
           >  proposals (RPN boxes only, class-agnostic)
 
@@ -22,10 +25,10 @@ import time
 from typing import Callable, Mapping, Optional, Sequence
 
 # Quality-ordered serving levels, best first.  ``small`` reuses the FULL
-# program of a smaller resolution bucket; ``reduced`` and ``proposals``
-# are distinct compiled programs (engine warmup compiles them up front so
-# degrading never pays a compile mid-incident).
-LEVELS = ("full", "small", "reduced", "proposals")
+# program of a smaller resolution bucket; ``full_q8``, ``reduced`` and
+# ``proposals`` are distinct compiled programs (engine warmup compiles
+# them up front so degrading never pays a compile mid-incident).
+LEVELS = ("full", "small", "full_q8", "reduced", "proposals")
 
 # Levels that run the full-quality pipeline; the circuit breaker guards
 # these (a failing/overrunning full path should stop being probed at

@@ -117,6 +117,8 @@ class DetectorRunner:
 
     Programs (all compiled at warmup, none ever added after):
       * ``("full", bucket)`` for EVERY bucket — the production detector.
+      * ``("full_q8", smallest bucket)`` — int8/bf16 box head
+        (serve/quantize.py), when built with ``int8_head=True``.
       * ``("reduced", smallest bucket)`` — ``reduced_max_detections``
         output slots (cheaper postprocess/NMS).
       * ``("proposals", smallest bucket)`` — RPN-only, class-agnostic.
@@ -136,6 +138,7 @@ class DetectorRunner:
         batch_size: int = 1,
         reduced_max_detections: Optional[int] = None,
         with_proposals: bool = True,
+        int8_head: bool = False,
     ) -> None:
         import dataclasses
 
@@ -192,6 +195,29 @@ class DetectorRunner:
             ),
         }
         self._program_keys = [("full", b) for b in self.buckets]
+        if int8_head:
+            from mx_rcnn_tpu.serve.quantize import (
+                apply_box_head_q8,
+                quantize_box_head,
+            )
+
+            # The quantized tree rides as a jit ARGUMENT (device buffers),
+            # not a closure — same request-size reasoning as _variables.
+            self._box_q8 = jax.device_put(quantize_box_head(variables))
+            q8_step = jax.jit(
+                lambda v, q, b: forward_inference(
+                    model, v, b, pixel_stats=stats,
+                    box_head_apply=lambda pooled: apply_box_head_q8(
+                        q, pooled
+                    ),
+                )
+            )
+            self._steps["full_q8"] = (
+                lambda v, b: q8_step(v, self._box_q8, b)
+            )
+            # Like the other degrade programs, compiled for the smallest
+            # bucket only (engine._plan routes non-full levels there).
+            self._program_keys.append(("full_q8", self.buckets[0]))
         if with_proposals:
             self._program_keys += [
                 ("reduced", self.buckets[0]),
@@ -207,6 +233,8 @@ class DetectorRunner:
         out = ["full"]
         if len(self.buckets) > 1:
             out.append("small")
+        if any(m == "full_q8" for m, _ in self._program_keys):
+            out.append("full_q8")
         out.append("reduced")
         if any(m == "proposals" for m, _ in self._program_keys):
             out.append("proposals")
@@ -680,11 +708,13 @@ def build_engine(
     variables,
     buckets: Optional[Sequence[tuple[int, int]]] = None,
     batch_size: int = 1,
+    int8_head: bool = False,
     **engine_kwargs,
 ) -> InferenceEngine:
     """Convenience: real runner + engine from a config and variables
     (checkpoint-restored or freshly initialized)."""
     runner = DetectorRunner(
-        cfg, variables, buckets=buckets, batch_size=batch_size
+        cfg, variables, buckets=buckets, batch_size=batch_size,
+        int8_head=int8_head,
     )
     return InferenceEngine(runner, **engine_kwargs)

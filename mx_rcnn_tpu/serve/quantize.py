@@ -1,0 +1,100 @@
+"""int8/bf16 serving program for the RCNN box head.
+
+Weight-only symmetric per-output-channel int8 over the four BoxHead
+Dense kernels (fc6 / fc7 / cls_score / bbox_pred); biases stay f32.  At
+serving time the int8 weights dequantize to bf16 in-graph (one f32
+multiply per weight, fused by XLA into the parameter load — the same
+shape of trick as the frozen-BN fold) and the dots run bf16 x bf16 with
+f32 accumulation via ``preferred_element_type`` — the MXU's native
+mode.  Logits/deltas are emitted f32, the BoxHead output contract, so
+postprocess (softmax, decode, NMS) is byte-for-byte the production
+graph.
+
+Why weight-only and why only the box head: this is the one place
+serving wins from int8 with NO calibration data.  The head's Dense
+kernels dominate its bytes (fc6 alone is ``S*S*C x 1024``; the VGG
+recipe's fc6/fc7 are ~0.5 GB of f32 — 4x smaller as int8), while its
+activations are a few thousand pooled rows — activation quantization
+would buy little and cost a calibration sweep.  The backbone stays
+bf16: convs are compute-bound on the MXU, so int8 weights there save
+HBM traffic the backbone doesn't bottleneck on.
+
+Numerics: symmetric int8 with per-output-channel scales keeps the
+worst-case relative weight error ~= 1/254 per channel; the acceptance
+tolerance (tests/test_precision.py) is on final scores/boxes, not
+weights, because the softmax/NMS pipeline absorbs sub-percent logit
+noise for all but threshold-straddling detections.
+
+Wiring: :func:`quantize_box_head` runs once at runner construction (the
+quantized tree is device_put and PASSED AS AN ARGUMENT to the jitted
+step — closed-over arrays would embed as HLO constants and blow the
+remote-compile request limit, see serve/engine.py's eval note);
+:func:`apply_box_head_q8` is injected into
+``detection/graph.py::forward_inference`` through ``box_head_apply``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.utils.precision import dequantize, quantize_per_channel
+
+# The BoxHead Dense layers, in application order (models/heads.py).
+QUANT_LAYERS = ("fc6", "fc7", "cls_score", "bbox_pred")
+
+
+def quantize_box_head(variables) -> dict:
+    """Quantize the box head's Dense kernels out of a full variables tree.
+
+    Returns ``{layer: {"q": int8 (in, out), "scale": f32 (1, out),
+    "bias": f32 (out,)}}`` — a plain pytree, safe to ``device_put`` and
+    pass through jit boundaries."""
+    params = variables["params"]["box_head"]
+    out = {}
+    for name in QUANT_LAYERS:
+        q, scale = quantize_per_channel(
+            jnp.asarray(params[name]["kernel"]), axis=-1
+        )
+        out[name] = {
+            "q": q,
+            "scale": scale,
+            "bias": jnp.asarray(params[name]["bias"], jnp.float32),
+        }
+    return out
+
+
+def apply_box_head_q8(
+    qtree: dict, pooled: jnp.ndarray, compute_dtype: Any = jnp.bfloat16
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The int8/bf16 box-head program (BoxHead.__call__'s contract).
+
+    pooled: (R, S, S, C) pooled features -> f32 (R, num_classes) logits,
+    f32 (R, n_reg, 4) deltas.  Each Dense: dequant int8 -> bf16 weights,
+    bf16 activations, f32-accumulated dot, f32 bias add; ReLU runs on
+    the f32 accumulator and the result downcasts once into the next
+    layer's bf16 operand.
+    """
+
+    def dense(x: jnp.ndarray, name: str) -> jnp.ndarray:
+        layer = qtree[name]
+        w = dequantize(layer["q"], layer["scale"], compute_dtype)
+        y = jax.lax.dot_general(
+            x.astype(compute_dtype), w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return y + layer["bias"]
+
+    r = pooled.shape[0]
+    x = pooled.reshape(r, -1)
+    x = jax.nn.relu(dense(x, "fc6"))
+    x = jax.nn.relu(dense(x, "fc7"))
+    logits = dense(x, "cls_score")
+    deltas = dense(x, "bbox_pred")
+    return (
+        logits.astype(jnp.float32),
+        deltas.reshape(r, -1, 4).astype(jnp.float32),
+    )

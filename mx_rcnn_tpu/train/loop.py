@@ -377,8 +377,14 @@ def train(
         host_it = loader.iter_from(skip_batches=from_step + extra_skip)
         if k > 1:
             host_it = _stacked_batches(host_it, k)
+        # host_depth=1: the one-step host double buffer — decode/augment/
+        # stack for batch k+1 runs on a background thread while batch k's
+        # step occupies the device, on top of the async device_put depth.
+        # Batch ORDER is untouched, so the data schedule (and chaos
+        # bit-exact resume) is identical to the synchronous pipeline.
         return device_prefetch(
             host_it, mesh, depth=2, spatial=spatial, stacked=k > 1,
+            host_depth=1,
         )
 
     # Rollback safety net: make sure SOME checkpoint exists before the
@@ -462,6 +468,7 @@ def train(
                     # The retried window consumes the batches AFTER the
                     # offending one — skip forward, never replay poison.
                     data_skip += done - restored
+                    it.close()  # stop the superseded host-prefetch thread
                     it = data_iter(restored, data_skip)
                     if writer:
                         writer.truncate(restored)
@@ -489,8 +496,12 @@ def train(
                     )
                 if writer:
                     writer.close()
+                it.close()
                 raise Preempted(done, ckpt_dir if workdir else None)
             i = done
+    # Stop the host-prefetch thread (generator close -> _HostPrefetcher
+    # close); GC would get there eventually, but be prompt about it.
+    it.close()
     profiler.close(sync=state.params)
     if writer:
         writer.close()

@@ -67,10 +67,19 @@ def host_interval_metrics(
     last value (a schedule read, not a statistic).  The per-step list is
     the guardian's detection input (train/guardian.py): the finiteness
     verdict needs every step's value, and it comes out of the SAME
-    transfer as the means — detection adds no host syncs."""
+    transfer as the means — detection adds no host syncs.
+
+    Accumulation dtype contract: the device-side metric leaves arrive
+    float32 by construction (every loss/metric upcast happens inside its
+    accumulation scope — detection/graph.py, parallel/step.py) and the
+    interval mean below runs in host Python floats (f64).  The explicit
+    float64 cast makes the host half of that contract hold even for a
+    metric leaf that somehow arrives bf16 — interval means never
+    accumulate in half precision."""
     flat = jax.device_get(pending)
     steps = [
-        {k: float(np.asarray(v)) for k, v in d.items()} for d in flat
+        {k: float(np.asarray(v, np.float64)) for k, v in d.items()}
+        for d in flat
     ]
     out: dict[str, float] = {}
     for k in steps[-1]:

@@ -1,0 +1,137 @@
+"""Mixed-precision policy: one resolved object answers every dtype question.
+
+The graph has exactly three precision regions, and the policy names a
+dtype for each:
+
+- ``compute_dtype`` — conv/matmul activations inside the model (backbone,
+  FPN, RPN/box/mask heads).  Params are always float32 masters
+  (``param_dtype``); flax casts them to the compute dtype per apply, and
+  the backward re-accumulates gradients in float32 through the transpose
+  of that cast.
+- ``output_dtype`` — what the heads *emit* across the model/detection
+  boundary.  Historically this was hard ``float32`` (every head ended in
+  ``.astype(jnp.float32)``), which materialized the (B, ~268k) RPN logit
+  and (B, ~268k, 4) delta tensors in f32 and dragged the whole detection
+  middle (sigmoid, top-k, NMS score lanes) to f32 with them.  Under the
+  ``"mixed"`` policy it equals ``compute_dtype``.
+- ``accum_dtype`` — where sums happen: losses, metrics, the guardian
+  finiteness reduction, the optimizer.  Always float32 in shipped
+  policies; every upcast into it sits inside a named scope on the
+  tpulint TPU006 accumulation allowlist
+  (``analysis/jaxpr_checks.py::UPCAST_ALLOWLIST``).
+
+Box *coordinates* are deliberately not a policy axis: anchors and rois
+are f32 constants/gathers, so delta decoding auto-promotes to f32 at the
+(post-top-k, few-thousand-row) point where coordinates are materialized.
+bf16 has ~8 mantissa bits — a 4-pixel quantization at x = 1024 — so
+coordinate math in bf16 would cost real mAP for no measurable time: the
+big tensors are the score/logit lanes, and those do ride bf16.
+
+Policies (``config.PrecisionConfig.policy``):
+
+=========  =============  ============  ===========
+policy     compute        output        accum
+=========  =============  ============  ===========
+mixed      backbone.dtype compute       float32
+widen      backbone.dtype float32       float32
+float32    float32        float32       float32
+=========  =============  ============  ===========
+
+``"mixed"`` with a float32 backbone (tiny_synthetic) degenerates to the
+all-f32 policy, so hermetic CPU goldens are bit-identical by
+construction.  ``"widen"`` reproduces the pre-r6 graphs exactly — the
+A/B and bisection escape hatch.
+
+Serving-side int8 weight-only quantization helpers live here too
+(``quantize_per_channel`` / ``dequantize``): symmetric per-output-channel
+int8 with f32 scales, used by ``serve/quantize.py`` to build the
+int8/bf16 RCNN-head program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+_NAMED = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+POLICIES = ("mixed", "widen", "float32")
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Resolved dtype policy.  Hashable/frozen so it can ride static args."""
+
+    name: str
+    compute_dtype: Any
+    output_dtype: Any
+    accum_dtype: Any
+    param_dtype: Any = jnp.float32
+
+    def cast_compute(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(self.compute_dtype)
+
+    def cast_output(self, x: jnp.ndarray) -> jnp.ndarray:
+        return x.astype(self.output_dtype)
+
+    def upcast(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Accumulation-precision entry: use ONLY under an allowlisted
+        named scope (losses/metrics/guardian/optimizer) — TPU006 flags
+        bf16->f32 converts anywhere else on the forward hot path."""
+        return x.astype(self.accum_dtype)
+
+
+def resolve(policy: str, backbone_dtype: str, accum: str = "float32") -> Policy:
+    """Resolve a named policy against the backbone compute-dtype knob."""
+    if policy not in POLICIES:
+        raise ValueError(f"unknown precision policy {policy!r}; one of {POLICIES}")
+    if backbone_dtype not in _NAMED:
+        raise ValueError(f"unknown dtype {backbone_dtype!r}")
+    if accum not in _NAMED:
+        raise ValueError(f"unknown accum dtype {accum!r}")
+    compute = jnp.float32 if policy == "float32" else _NAMED[backbone_dtype]
+    output = compute if policy == "mixed" else jnp.float32
+    return Policy(
+        name=policy,
+        compute_dtype=compute,
+        output_dtype=output,
+        accum_dtype=_NAMED[accum],
+    )
+
+
+def policy_of(model_cfg: Any) -> Policy:
+    """Resolve the policy for a ``config.ModelConfig`` (duck-typed: needs
+    ``.precision.policy``/``.precision.accum`` and ``.backbone.dtype``,
+    so older pickled configs without a precision section default clean)."""
+    prec = getattr(model_cfg, "precision", None)
+    if prec is None:
+        return resolve("widen", model_cfg.backbone.dtype)
+    return resolve(prec.policy, model_cfg.backbone.dtype, prec.accum)
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only quantization (serving)
+# ---------------------------------------------------------------------------
+
+
+def quantize_per_channel(w: jnp.ndarray, axis: int = -1):
+    """Symmetric per-channel int8 quantization along ``axis`` (the output
+    channel): q = round(w / s), s = amax(|w|) / 127 per channel.  Returns
+    ``(q int8, scale f32)`` with ``scale`` shaped to broadcast against
+    ``q``.  Zero channels get scale 1 so dequantization stays exact."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=tuple(
+        i for i in range(w.ndim) if i != axis % w.ndim
+    ), keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype: Any = jnp.bfloat16):
+    """Dequantize int8 weights to the serving compute dtype.  The scale
+    multiply runs in f32 then downcasts once — same contract as the
+    frozen-BN fold (scale rides the existing weight cast)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -300,3 +300,81 @@ class TestSpatialPartition:
         mesh = make_mesh(jax.devices(), model_parallel=2)
         *_, gb = build_all(cfg, mesh)
         assert gb == 4  # 8 devices / sp 2
+
+
+class TestHostPrefetcher:
+    """The r6 host-side double buffer (parallel/prefetch.py): batch order
+    is the determinism contract (quarantine substitution, chaos bit-exact
+    resume all key off it), exceptions belong to the stream position they
+    occurred at, and close() must actually stop the thread."""
+
+    def test_order_preserved(self):
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        p = _HostPrefetcher(iter(range(200)), depth=4)
+        assert list(p) == list(range(200))
+
+    def test_exception_relayed_after_preceding_items(self):
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        def src():
+            yield 0
+            yield 1
+            raise ValueError("loader died")
+
+        p = _HostPrefetcher(src(), depth=2)
+        assert next(p) == 0
+        assert next(p) == 1
+        with pytest.raises(ValueError, match="loader died"):
+            next(p)
+        # A failed stream stays terminated.
+        with pytest.raises(StopIteration):
+            next(p)
+
+    def test_close_stops_thread_while_producer_blocked(self):
+        import itertools
+
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        p = _HostPrefetcher(itertools.count(), depth=1)
+        assert next(p) == 0
+        p.close()  # producer is blocked on a full queue right now
+        assert not p._thread.is_alive()
+
+    def test_device_prefetch_generator_close_joins_thread(self):
+        import itertools
+        import threading
+
+        from mx_rcnn_tpu.parallel.prefetch import device_prefetch
+
+        def alive():
+            return [
+                t for t in threading.enumerate()
+                if t.name == "host-prefetch" and t.is_alive()
+            ]
+
+        before = len(alive())
+        gen = device_prefetch(
+            iter(np.arange(64).reshape(8, 8)), mesh=None, depth=2
+        )
+        assert np.asarray(next(gen)).shape == (8,)
+        assert len(alive()) == before + 1
+        gen.close()
+        assert len(alive()) == before
+
+    def test_host_depth_zero_is_synchronous_fallback(self):
+        import threading
+
+        from mx_rcnn_tpu.parallel.prefetch import device_prefetch
+
+        n_before = len(
+            [t for t in threading.enumerate() if t.name == "host-prefetch"]
+        )
+        out = list(
+            device_prefetch(iter(range(10)), mesh=None, depth=2, host_depth=0)
+        )
+        assert [int(np.asarray(x)) for x in out] == list(range(10))
+        n_after = len(
+            [t for t in threading.enumerate() if t.name == "host-prefetch"]
+        )
+        assert n_after == n_before

@@ -8,7 +8,9 @@ Runs both static-analysis layers (mx_rcnn_tpu/analysis/) and writes
   fail.
 * layer 2 — jaxpr/HLO invariants on the real jitted train/eval/proposal
   steps (f64-free, transfer-guard-clean, trace-deterministic,
-  donation-applied, >=99% FLOP attribution).  No suppressions.
+  donation-applied, >=99% FLOP attribution, and TPU006: no bf16->f32
+  upcast outside the accumulation allowlist in the bf16-mixed train
+  step).  No suppressions.
 
 Usage:
   python tools/tpulint.py --check                 # CI gate: exit 1 on any
