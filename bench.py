@@ -80,6 +80,7 @@ def resolved_knobs(cfg) -> dict:
         "roi_align_impl": m.rcnn.roi_align_impl,
         "roi_align_bwd_impl": m.rcnn.roi_align_bwd_impl,
         "steps_per_call": cfg.train.steps_per_call,
+        "accum_steps": cfg.train.accum_steps,
         "per_device_batch": cfg.train.per_device_batch,
     }
 
@@ -245,10 +246,12 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
     import jax
 
     from mx_rcnn_tpu.data import DetectionLoader, SyntheticDataset
-    from mx_rcnn_tpu.parallel.prefetch import device_prefetch
+    from mx_rcnn_tpu.parallel.prefetch import PrefetchStats, device_prefetch
     from mx_rcnn_tpu.train.loop import _stacked_batches
 
     k = max(cfg.train.steps_per_call, 1)
+    accum = max(cfg.train.accum_steps, 1)
+    stack = max(k, accum)
     # uint8 synthetic pixels: same batch dtype as the main phase's program
     # (no recompile) and the production transfer size — 3 MB/image at the
     # recipe canvas instead of the f32 path's 12.
@@ -257,16 +260,21 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
         dtype="uint8",
     ).roidb()
     loader = DetectionLoader(
-        roidb, cfg.data, batch_size=global_batch, prefetch=False
+        roidb, cfg.data, batch_size=global_batch // accum, prefetch=False
     )
     host_it = iter(loader)
-    if k > 1:
-        host_it = _stacked_batches(host_it, k)
-    it = device_prefetch(host_it, mesh=None, depth=2, stacked=k > 1)
+    if stack > 1:
+        host_it = _stacked_batches(host_it, stack)
+    stats = PrefetchStats()
+    it = device_prefetch(
+        host_it, mesh=None, depth=2, stacked=stack > 1, host_depth=1,
+        stats=stats,
+    )
     # Warm (program is already compiled from the synthetic phase).
     state, metrics = step_fn(state, next(it))
     leaf = jax.tree_util.tree_leaves(state.params)[0]
     jax.device_get((metrics["loss"], leaf.ravel()[0]))
+    stats.take()  # warmup stall is compile wait, not loader speed
     n_calls = max(n_steps // k, 2)
     t0 = time.perf_counter()
     for _ in range(n_calls):
@@ -274,10 +282,33 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
     leaf = jax.tree_util.tree_leaves(state.params)[0]
     jax.device_get((metrics["loss"], leaf.ravel()[0]))
     dt = time.perf_counter() - t0
+    n_steps_done = n_calls * k
     img_s = n_calls * k * global_batch / dt
+    stall_s, _ = stats.take()
+    h, w = cfg.data.image_size
+    platform = jax.default_backend()
+    # Data-starvation stage line (satellite of the train_stage_ms
+    # breakdown): ms/step the consumer blocked in next(loader) PAST the
+    # prefetch double buffer.  ~0 means the step hides the loader; a
+    # value near the step time means the run is input-bound and device
+    # optimizations will not move the headline.
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"train_stage_ms[data_stall@{h}x{w},"
+                    f"b{global_batch},{platform}]"
+                ),
+                "value": round(stall_s * 1e3 / n_steps_done, 3),
+                "unit": "ms/step",
+                "stalled_frac": round(stall_s / dt, 4),
+            }
+        )
+    )
     print(
         f"loader-fed (host->device each step): {img_s:.2f} img/s "
-        f"({n_calls * k} steps in {dt:.1f}s)",
+        f"({n_steps_done} steps in {dt:.1f}s, "
+        f"data stall {stall_s:.2f}s)",
         file=sys.stderr,
     )
     return img_s
@@ -459,6 +490,11 @@ def main() -> None:
     configure_cache(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
         min_compile_secs=10,
+        # Bench artifacts are produced on whichever host holds the checkout
+        # this round; when the LLVM-feature probe is unavailable, keep the
+        # hosts' XLA:CPU blob caches strictly separate (MULTICHIP_r0*
+        # foreign-blob SIGILL tails).
+        strict_host=True,
     )
 
     from mx_rcnn_tpu.config import apply_overrides, get_config
@@ -500,8 +536,17 @@ def main() -> None:
         image_size = cfg.data.image_size
         batch = cfg.train.per_device_batch
         k = max(cfg.train.steps_per_call, 1)
+        if cfg.train.accum_steps > 1 and k > 1:
+            # The plan forbids the combination; surface it as a CLI error
+            # instead of a trace-time ValueError.
+            ap.error("train.accum_steps and train.steps_per_call are "
+                     "mutually exclusive (both stack the leading axis)")
     else:
         assert_headline_fastpath(cfg)
+    # Leading-axis stack: K scanned optimizer steps OR N accumulated
+    # microbatches (mutually exclusive; plan-validated).
+    accum = max(cfg.train.accum_steps, 1)
+    stack = max(k, accum)
     # Knob provenance line, FIRST json line of the artifact (the headline
     # metric stays the last — existing consumers key off that).
     print(json.dumps({"metric": "bench_knobs", "value": resolved_knobs(cfg)}))
@@ -521,7 +566,7 @@ def main() -> None:
         )
         return
     model, tx, state, step_fn, global_batch = build_all(cfg, mesh=None)
-    data = _synthetic_batch(cfg, batch, image_size, k)
+    data = _synthetic_batch(cfg, batch, image_size, stack)
 
     # Device-resident batch: the metric is the train step (fwd+bwd+update);
     # input delivery is measured separately (--loader) because the axon
@@ -544,14 +589,16 @@ def main() -> None:
         state, metrics = step_fn(state, data)
     sync(state, metrics)
     n_calls = 6 if on_accel else 5
-    n_steps = n_calls * k
+    # Images processed per call: K steps x batch, or batch x N
+    # microbatches per accumulated step — `stack * batch` either way.
+    n_steps = n_calls * stack
     t0 = time.perf_counter()
     for _ in range(n_calls):
         state, metrics = step_fn(state, data)
     sync(state, metrics)
     dt = time.perf_counter() - t0
 
-    _cost_analysis(step_fn, state, data, k, dt / n_calls)
+    _cost_analysis(step_fn, state, data, stack, dt / n_calls)
 
     # Per-step percentiles (sync per step — includes one tunnel round-trip
     # per step, an upper bound) on stderr.
@@ -563,9 +610,10 @@ def main() -> None:
             state, metrics = step_fn(state, data)
             sync(state, metrics)
     per_call = timer.summary()
-    per_step = {key: v / k if key != "steps" else v for key, v in per_call.items()}
+    per_step = {key: v / stack if key != "steps" else v for key, v in per_call.items()}
     print(
-        f"per-call (K={k} steps, synced upper bound): {per_call}\n"
+        f"per-call (K={k} steps x N={accum} microbatches, synced upper "
+        f"bound): {per_call}\n"
         f"per-step equivalent: {per_step}",
         file=sys.stderr,
     )

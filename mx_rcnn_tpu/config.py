@@ -364,6 +364,13 @@ class TrainConfig:
     # runtimes — measured ~25 ms/call through the axon tunnel) K-fold.
     # Logging/checkpoint cadence quantizes to K.
     steps_per_call: int = 1
+    # Microbatches accumulated per optimizer step (parallel/plan.py): >1
+    # scans N microbatches with f32 gradient accumulators and applies ONE
+    # update — the global batch multiplies by N without more chips (the
+    # large-minibatch lever when the target batch exceeds device memory).
+    # Mutually exclusive with steps_per_call>1 and spatial_partition>1.
+    # 1 is bit-identical to the plain step.
+    accum_steps: int = 1
     momentum: float = 0.9
     weight_decay: float = 1e-4
     grad_clip: float = 35.0  # reference: clip_gradient=5 per-example scale

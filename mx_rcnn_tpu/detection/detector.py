@@ -39,6 +39,24 @@ class TwoStageDetector(nn.Module):
             return tuple(range(self.cfg.fpn.min_level, min(self.cfg.fpn.max_level, 5) + 1))
         return (4,)
 
+    def param_families(self) -> tuple[str, ...]:
+        """Top-level param-tree names this config instantiates.
+
+        The canonical vocabulary the execution plan's partition rules are
+        built over (parallel/plan.py): every param, optimizer-momentum and
+        BN-stat leaf carries exactly one of these names in its path.  A new
+        head added without extending this list (and the rule set) fails the
+        plan's unmatched-leaf check at build time rather than silently
+        training unsharded.
+        """
+        fams = ["backbone"]
+        if self.cfg.fpn.enabled:
+            fams.append("fpn")
+        fams += ["rpn", "box_head"]
+        if self.cfg.mask.enabled:
+            fams.append("mask_head")
+        return tuple(fams)
+
     def setup(self):
         cfg = self.cfg
         # The resolved mixed-precision policy (utils/precision.py) owns

@@ -565,7 +565,7 @@ def init_detector(model: TwoStageDetector, rng: jax.Array, image_size, batch: in
 
 
 def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Batch,
-                  mesh=None, pixel_stats=None):
+                  mesh=None, pixel_stats=None, rngs=None):
     """One full training forward pass -> (total_loss, metrics dict).
 
     Differentiable w.r.t. ``variables['params']``.  Equivalent of the
@@ -573,13 +573,24 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
     both CustomOp host syncs replaced by in-graph ops.  ``mesh``: >1-chip
     data mesh for the shard_map'd Pallas ROIAlign (see :func:`_pool_rois`).
     ``pixel_stats``: (mean, std) for uint8 batches (see :func:`prep_images`).
+
+    ``rngs``: optional ``(assign_keys, sample_keys)`` per-image key arrays
+    (each (B, 2), rows as produced by ``jax.random.split(..., B)``) that
+    REPLACE the internal split of ``rng`` (then ignored; pass None).  The
+    gradient-accumulation step uses this to hand each microbatch its slice
+    of the keys a single big batch would derive, so microbatched and
+    monolithic steps sample identical anchors/rois per image
+    (parallel/step.py).  When omitted the split happens here exactly as it
+    always has — the default trace is unchanged.
     """
     cfg = model.cfg
     images = prep_images(batch.images, pixel_stats)
     feats = model.apply(variables, images, method="features")
 
     b = images.shape[0]
-    rng_assign, rng_sample = jax.random.split(rng)
+    rng_assign = rng_sample = None
+    if rngs is None:
+        rng_assign, rng_sample = jax.random.split(rng)
 
     # gt_ignore=None keeps the cheaper no-IoA graph (in_axes=None maps the
     # leafless None through vmap untouched; the callees skip the overlap
@@ -610,7 +621,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
                 ),
                 in_axes=(0, 0, 0, gi_axis, 0),
             )(
-                jax.random.split(rng_assign, b),
+                rngs[0] if rngs is not None else jax.random.split(rng_assign, b),
                 batch.gt_boxes,
                 batch.gt_valid,
                 gt_ignore,
@@ -650,7 +661,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
             ),
             in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
         )(
-            jax.random.split(rng_sample, b),
+            rngs[1] if rngs is not None else jax.random.split(rng_sample, b),
             prop_rois,
             prop_valid,
             batch.gt_boxes,

@@ -10,6 +10,11 @@ inserts the gradient all-reduce over ICI (DCN across slices) when it
 compiles the jitted train step.  Synchronous and deterministic — the
 semantic equivalent of ``dist_sync`` + ``device`` aggregation with none of
 the machinery.
+
+Every compile goes through the :class:`~mx_rcnn_tpu.parallel.plan.
+ExecutionPlan` (plan.py): regex partition rules over canonical state-leaf
+names decide the layout once, for train, eval, serving, and
+checkpoint-restore alike.
 """
 
 from mx_rcnn_tpu.parallel.distributed import initialize
@@ -19,16 +24,25 @@ from mx_rcnn_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
-from mx_rcnn_tpu.parallel.prefetch import device_prefetch
+from mx_rcnn_tpu.parallel.plan import (
+    ExecutionPlan,
+    family_rules,
+    match_partition_rules,
+)
+from mx_rcnn_tpu.parallel.prefetch import PrefetchStats, device_prefetch
 from mx_rcnn_tpu.parallel.step import make_eval_step, make_train_step
 
 __all__ = [
+    "ExecutionPlan",
+    "PrefetchStats",
     "batch_sharding",
     "device_prefetch",
+    "family_rules",
     "initialize",
     "make_eval_step",
     "make_mesh",
     "make_train_step",
+    "match_partition_rules",
     "replicated",
     "shard_batch",
 ]

@@ -102,3 +102,21 @@ def initialize(
         jax.process_index(), jax.process_count(),
         jax.local_device_count(), jax.device_count(),
     )
+
+
+def describe_plan(plan) -> str:
+    """One-line placement summary for run-start logs (all hosts see the
+    SAME plan by construction — it is a pure function of cfg + mesh, so
+    logging it per host doubles as a cheap lockstep sanity check in
+    multi-host stdouts)."""
+    mesh = plan.mesh
+    if mesh is None:
+        return "plan: single-device (no mesh)"
+    return (
+        f"plan: mesh {dict(mesh.shape)} over {jax.process_count()} "
+        f"process(es), {len(plan.rules)} partition rules, "
+        f"accum_steps={plan.accum_steps}, "
+        f"steps_per_call={plan.steps_per_call}, "
+        f"spatial={plan.spatial}, "
+        f"step={'shard_map' if plan.use_shard_map else 'jit+gspmd'}"
+    )

@@ -1,0 +1,263 @@
+"""The execution plan: one sharding + compilation policy for every step.
+
+Train, eval, and serving used to each assemble their own ``jax.jit``
+scaffolding (replicated-state broadcast, per-field batch shardings,
+donation) inline in ``parallel/step.py`` and ``serve/engine.py``.  The
+:class:`ExecutionPlan` centralizes all of it, GSPMD-style (Xu et al.
+2021): the program is written once, and the plan annotates it —
+
+- **Regex partition rules** over the canonical "/"-joined param-tree
+  names (train/state.py::leaf_paths) resolve every state leaf to a
+  ``PartitionSpec``.  Scalars are replicated automatically; a leaf no
+  rule matches is a HARD error — new heads must extend the rule
+  vocabulary (detector.py::param_families), never silently default.
+  Param names recur inside optax wrapper paths (``.../trace/backbone/
+  conv1/kernel``) and BN stats (``batch_stats/backbone/...``), so one
+  family rule covers the parameter, its momentum, and its stats.
+- **Compilation**: ``jit`` + ``NamedSharding`` when the program is a
+  single global computation (the default — XLA's SPMD pass inserts the
+  gradient all-reduce), ``shard_map`` when the rules require explicit
+  per-shard control (gradient accumulation: grads accumulate LOCALLY
+  across microbatches and all-reduce once, instead of once per scan
+  iteration as GSPMD would schedule a replicated carry).
+- **Placement**: state device layout (``shard_state``) and the
+  checkpoint-restore target shardings (train/checkpoint.py) both come
+  from the same rule match, so a restored pod run never round-trips
+  through a host-replicated layout.
+
+Today every rule resolves to ``P()`` (pure data parallelism — reference
+parity); the machinery exists so tensor layouts can be introduced per
+family by editing ONE rule, not re-plumbing three call sites.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mx_rcnn_tpu.detection.graph import Batch
+from mx_rcnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from mx_rcnn_tpu.train.state import leaf_paths
+
+# Non-model state the rules must always cover: the per-step folding base
+# is a (2,) uint32 key — not a scalar, so the auto-replicate path does
+# not catch it.
+_STATE_RULES: tuple[tuple[str, P], ...] = ((r"(^|/)rng$", P()),)
+
+
+def family_rules(families: Sequence[str]) -> tuple[tuple[str, P], ...]:
+    """One replicate rule per param family — the pure-DP layout.
+
+    Anchored on a path separator so ``rpn`` cannot accidentally match a
+    hypothetical ``some_rpn_like`` family: the rule hits ``backbone/``,
+    ``batch_stats/backbone/`` and ``.../trace/backbone/`` but never a
+    name that merely contains the family as a substring.
+    """
+    return _STATE_RULES + tuple(
+        (rf"(^|/){re.escape(f)}/", P()) for f in families
+    )
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], tree):
+    """Resolve every leaf of ``tree`` to a PartitionSpec.
+
+    Scalars (and 1-element leaves — optax counters) replicate without
+    consulting the rules; other leaves take the FIRST rule whose pattern
+    ``re.search``-matches their "/"-joined path.  An unmatched leaf is a
+    hard error listing the path and the rule vocabulary — the failure
+    mode this guards against is a new parameter family training under
+    an accidental default layout.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def resolve(name: str, leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        size = 1
+        for d in shape:
+            size *= d
+        if len(shape) == 0 or size == 1:
+            return P()
+        for pat, spec in compiled:
+            if pat.search(name):
+                return spec
+        raise ValueError(
+            f"no partition rule matches state leaf {name!r} "
+            f"(shape {tuple(shape)}); known rules: "
+            f"{[pat for pat, _ in rules]} — extend the plan's rule set "
+            "(parallel/plan.py::family_rules / "
+            "detector.py::param_families) for new parameter families"
+        )
+
+    named = leaf_paths(tree)
+    specs = [resolve(name, leaf) for name, leaf in named]
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionPlan:
+    """Mesh + partition rules + step-shape knobs, validated together.
+
+    ``accum_steps``: microbatches accumulated per optimizer step
+    (lax.scan, f32 accumulators).  ``steps_per_call``: optimizer steps
+    scanned per dispatch.  Exactly one of the two may exceed 1 — both
+    stack the batch's leading axis and composing them would need a
+    (K, N, B, ...) layout nothing produces.  ``spatial``: image heights
+    sharded over the mesh's model axis (big-image mode); incompatible
+    with accumulation (the accumulation shard_map owns the data axis and
+    would hide the model axis from XLA's conv partitioner).
+    """
+
+    mesh: Optional[Mesh] = None
+    rules: tuple[tuple[str, P], ...] = ()
+    spatial: bool = False
+    accum_steps: int = 1
+    steps_per_call: int = 1
+
+    def __post_init__(self):
+        if self.accum_steps < 1 or self.steps_per_call < 1:
+            raise ValueError(
+                f"accum_steps={self.accum_steps} / "
+                f"steps_per_call={self.steps_per_call} must be >= 1"
+            )
+        if self.accum_steps > 1 and self.steps_per_call > 1:
+            raise ValueError(
+                "accum_steps and steps_per_call both > 1: each stacks the "
+                "batch's leading axis — pick one"
+            )
+        if self.spatial:
+            if self.mesh is None:
+                raise ValueError("spatial partitioning needs a device mesh")
+            if self.accum_steps > 1:
+                raise ValueError(
+                    "spatial partitioning is incompatible with gradient "
+                    "accumulation (the accumulation shard_map owns the "
+                    "data axis; the model axis would be invisible to "
+                    "XLA's spatial conv partitioning)"
+                )
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def for_model(
+        cls,
+        model,
+        mesh: Optional[Mesh] = None,
+        spatial: bool = False,
+        accum_steps: int = 1,
+        steps_per_call: int = 1,
+    ) -> "ExecutionPlan":
+        """Rules from the model's own family vocabulary (pure DP)."""
+        return cls(
+            mesh=mesh,
+            rules=family_rules(model.param_families()),
+            spatial=spatial,
+            accum_steps=accum_steps,
+            steps_per_call=steps_per_call,
+        )
+
+    # -- properties -------------------------------------------------------
+
+    @property
+    def stacked(self) -> bool:
+        """Batches carry a leading (K or N) axis."""
+        return self.steps_per_call > 1 or self.accum_steps > 1
+
+    @property
+    def use_shard_map(self) -> bool:
+        """The step body needs explicit per-shard control: gradient
+        accumulation over a data mesh accumulates locally and
+        all-reduces once (jit+GSPMD would all-reduce every microbatch
+        of a replicated scan carry)."""
+        return self.accum_steps > 1 and self.mesh is not None
+
+    @property
+    def data_shards(self) -> int:
+        return 1 if self.mesh is None else self.mesh.shape[DATA_AXIS]
+
+    # -- specs and shardings ---------------------------------------------
+
+    def state_specs(self, state):
+        """PartitionSpec pytree for a TrainState (hard error on an
+        unmatched non-scalar leaf)."""
+        return match_partition_rules(self.rules, state)
+
+    def state_shardings(self, state):
+        """NamedSharding pytree for ``state`` (None without a mesh)."""
+        if self.mesh is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            self.state_specs(state),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def shard_state(self, state):
+        """Place ``state`` per the rules (plain device_put off-mesh)."""
+        shardings = self.state_shardings(state)
+        if shardings is None:
+            return jax.device_put(state)
+        return jax.device_put(state, shardings)
+
+    def batch_specs(self) -> Batch:
+        """Per-field PartitionSpec prefix tree for a train Batch."""
+        lead = (None,) if self.stacked else ()
+        data = P(*lead, DATA_AXIS)
+        img = P(*lead, DATA_AXIS, MODEL_AXIS) if self.spatial else data
+        return Batch(
+            images=img,
+            image_hw=data, gt_boxes=data, gt_classes=data, gt_valid=data,
+            gt_masks=data, gt_ignore=data, ext_rois=data, ext_valid=data,
+        )
+
+    def batch_shardings(self) -> Optional[Batch]:
+        if self.mesh is None:
+            return None
+        return Batch(*[
+            NamedSharding(self.mesh, spec) for spec in self.batch_specs()
+        ])
+
+    # -- compilation ------------------------------------------------------
+
+    def compile_step(self, fn, state_template=None):
+        """Jit a ``step(state, batch)`` under the plan's shardings.
+
+        State buffers are donated (params update in place in HBM).  With
+        a ``state_template`` the in/out state shardings are the per-leaf
+        rule match; without one, a broadcast replicated sharding — valid
+        only while every rule resolves to ``P()``, which the template
+        path would also produce today (identical compiled program).
+        """
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=(0,))
+        rep = NamedSharding(self.mesh, P())
+        state_sh = (
+            self.state_shardings(state_template)
+            if state_template is not None
+            else rep
+        )
+        return jax.jit(
+            fn,
+            in_shardings=(state_sh, self.batch_shardings()),
+            out_shardings=(state_sh, rep),
+            donate_argnums=(0,),
+        )
+
+    def compile_infer(self, fn, gather_outputs: bool = False):
+        """Jit an inference-shaped ``fn(variables, batch)``: replicated
+        params, data-sharded batch.  ``gather_outputs`` replicates the
+        outputs (multi-host eval: a host can only device_get what it
+        addresses).  Off-mesh: plain jit — the serving engine's path."""
+        if self.mesh is None:
+            return jax.jit(fn)
+        rep = NamedSharding(self.mesh, P())
+        data = NamedSharding(self.mesh, P(DATA_AXIS))
+        return jax.jit(
+            fn,
+            in_shardings=(rep, data),
+            out_shardings=rep if gather_outputs else data,
+        )

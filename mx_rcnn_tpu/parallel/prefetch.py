@@ -18,6 +18,12 @@ Two overlaps, two mechanisms:
 Batch ORDER is unchanged by both (single producer, single consumer,
 FIFO queue), so schedule determinism — quarantine substitution, chaos
 bit-exact resume — is preserved.
+
+:class:`PrefetchStats` measures what the overlap does NOT hide: the time
+the consumer blocks waiting for a batch that is not ready (the queue ran
+dry — the loader is slower than the step).  That stall is the
+input-bound signal; it feeds the ``data_stall_ms`` entry of the
+``train_stage_ms`` breakdown (bench.py, train/loop.py).
 """
 
 from __future__ import annotations
@@ -25,11 +31,40 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import jax
 
 from mx_rcnn_tpu.parallel.mesh import shard_batch
+
+
+class PrefetchStats:
+    """Consumer-side stall accounting for the host-prefetch stage.
+
+    ``stall_s`` accumulates ONLY time the consumer spends blocked waiting
+    for the producer (an empty handoff queue); a batch that is already
+    buffered costs ~0 regardless of how long the loader took to build it
+    — that work was hidden behind the device step, which is the point.
+    ``take()`` returns-and-resets, so callers meter per interval (the
+    training loop) or per timed window (bench) without seeding from a
+    wall clock.
+    """
+
+    def __init__(self) -> None:
+        self.stall_s = 0.0
+        self.batches = 0
+
+    def add(self, stall_s: float) -> None:
+        self.stall_s += stall_s
+        self.batches += 1
+
+    def take(self) -> tuple[float, int]:
+        """(accumulated stall seconds, batches) since the last take."""
+        out = (self.stall_s, self.batches)
+        self.stall_s = 0.0
+        self.batches = 0
+        return out
 
 
 class _HostPrefetcher:
@@ -44,9 +79,13 @@ class _HostPrefetcher:
 
     _DONE = object()
 
-    def __init__(self, it: Iterator, depth: int = 1):
+    def __init__(
+        self, it: Iterator, depth: int = 1,
+        stats: Optional[PrefetchStats] = None,
+    ):
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
+        self._stats = stats
         self._thread = threading.Thread(
             target=self._run, args=(it,), name="host-prefetch", daemon=True
         )
@@ -79,7 +118,19 @@ class _HostPrefetcher:
     def __next__(self):
         if self._stop.is_set():
             raise StopIteration
-        item, exc = self._q.get()
+        if self._stats is None:
+            item, exc = self._q.get()
+        else:
+            # Time ONLY the blocking wait: a non-empty queue short-circuits
+            # through get_nowait with no clock reads on the hot path's
+            # happy case beyond the two perf_counter calls.
+            try:
+                item, exc = self._q.get_nowait()
+                self._stats.add(0.0)
+            except queue.Empty:
+                t0 = time.perf_counter()
+                item, exc = self._q.get()
+                self._stats.add(time.perf_counter() - t0)
         if item is self._DONE:
             self._stop.set()
             if exc is not None:
@@ -98,9 +149,23 @@ class _HostPrefetcher:
         self._thread.join(timeout=5.0)
 
 
+def _timed_pulls(it: Iterator, stats: PrefetchStats) -> Iterator:
+    """host_depth=0 fallback: every pull is synchronous, so the whole
+    ``next(it)`` is consumer-blocking stall by definition."""
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        stats.add(time.perf_counter() - t0)
+        yield item
+
+
 def device_prefetch(
     it: Iterator, mesh: Optional[jax.sharding.Mesh], depth: int = 2,
     spatial: bool = False, stacked: bool = False, host_depth: int = 1,
+    stats: Optional[PrefetchStats] = None,
 ) -> Iterator:
     """Wrap a host batch iterator: batches come out device-resident (sharded
     over the mesh when given), ``depth`` transfers ahead of consumption.
@@ -108,10 +173,14 @@ def device_prefetch(
     ``host_depth``: batches the background host-prefetch thread reads
     ahead of the device_put stage (0 = synchronous pulls in the consumer
     thread — the pre-r6 behavior, kept for strictly single-threaded
-    debugging).  Closing the returned generator (``gen.close()``) stops
-    the thread."""
+    debugging).  ``stats``: optional :class:`PrefetchStats` accumulating
+    the consumer-side stall (data-starvation) time.  Closing the returned
+    generator (``gen.close()``) stops the thread."""
     q: collections.deque = collections.deque()
-    src: Iterator = it if host_depth <= 0 else _HostPrefetcher(it, host_depth)
+    if host_depth <= 0:
+        src: Iterator = it if stats is None else _timed_pulls(it, stats)
+    else:
+        src = _HostPrefetcher(it, host_depth, stats=stats)
 
     def put(batch):
         if mesh is not None:

@@ -1,10 +1,17 @@
-"""Jitted, sharded train and eval steps.
+"""Jitted, sharded train and eval steps, compiled through the execution plan.
 
 Replaces the reference's per-iteration runtime (SURVEY.md §4.1 hot loop):
 ``MutableModule.forward/backward/update`` + KVStore push/pull per parameter.
 One compiled XLA program does forward, backward, gradient all-reduce (ICI)
 and the optimizer update; there is no per-parameter communication schedule
 to manage because XLA fuses the collectives.
+
+All sharding/donation decisions live in :class:`~mx_rcnn_tpu.parallel.plan.
+ExecutionPlan` (parallel/plan.py) — train, eval, and serving compiles go
+through the same plan.  This module owns only the step BODIES: the fused
+fwd+bwd+update, the steps-per-call scan, and the gradient-accumulation
+loop (``shard_map`` over the data axis: grads accumulate locally in f32
+across microbatches and all-reduce ONCE per optimizer step).
 """
 
 from __future__ import annotations
@@ -14,12 +21,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from mx_rcnn_tpu.detection.detector import TwoStageDetector
 from mx_rcnn_tpu.detection.graph import Batch, forward_inference, forward_train
-from mx_rcnn_tpu.parallel.mesh import batch_sharding, replicated, spatial_sharding
+from mx_rcnn_tpu.parallel.mesh import DATA_AXIS, spatial_sharding
+from mx_rcnn_tpu.parallel.plan import ExecutionPlan
 from mx_rcnn_tpu.train.state import TrainState, state_variables
+from mx_rcnn_tpu.utils.precision import policy_of
 
 
 def make_train_step(
@@ -31,12 +41,15 @@ def make_train_step(
     trainable_mask=None,
     steps_per_call: int = 1,
     pixel_stats=None,
+    accum_steps: int = 1,
+    plan: Optional[ExecutionPlan] = None,
+    state_template: Optional[TrainState] = None,
 ):
     """Build ``step(state, batch) -> (state, metrics)``.
 
-    With a mesh: state replicated, batch sharded over the data axis; the
-    gradient all-reduce is implicit in XLA's SPMD partitioning (grads of
-    replicated params w.r.t. a sharded batch).  Without: plain single-device
+    With a mesh: state placed per the plan's partition rules (pure DP:
+    replicated), batch sharded over the data axis; the gradient all-reduce
+    is implicit in XLA's SPMD partitioning.  Without: plain single-device
     jit.  State buffers are donated — params update in place in HBM.
 
     ``spatial``: additionally shard the image height over the mesh's model
@@ -51,8 +64,30 @@ def make_train_step(
     set_to_zero on the same mask alone would still compute (then discard)
     those gradients.  Freezing the stem+stage1 is ~40% of the R50
     backbone's forward FLOPs whose weight-gradient pass disappears.
+
+    ``accum_steps`` > 1: the batch arrives STACKED (N, B, ...) and one
+    optimizer step accumulates gradients over the N microbatches
+    (``lax.scan``, f32 accumulators per utils/precision.py) — the
+    large-minibatch lever (Goyal et al. 2017) when the target global
+    batch exceeds what the chips hold.  ``accum_steps=1`` is bit-identical
+    to the plain step (it IS the plain step — same trace), so the chaos
+    harness's bit-exact-resume proof carries over unchanged.  Per-image
+    rng keys are derived for the FULL (N*B) global batch and sliced per
+    microbatch, so an accumulated step samples the same anchors/rois per
+    image as one monolithic (N*B,) batch would — the parity oracle
+    tests/test_plan.py asserts.
+
+    ``plan`` / ``state_template``: an explicit ExecutionPlan (otherwise
+    built from the model's family vocabulary) and a state whose structure
+    resolves the per-leaf in/out shardings (otherwise a broadcast
+    replicated spec — identical program while every rule is ``P()``).
     """
-    stacked = steps_per_call > 1
+    if plan is None:
+        plan = ExecutionPlan.for_model(
+            model, mesh=mesh, spatial=spatial, accum_steps=accum_steps,
+            steps_per_call=steps_per_call,
+        )
+    mesh, spatial = plan.mesh, plan.spatial
     spatial_spec = (
         spatial_sharding(mesh) if spatial and mesh is not None else None
     )
@@ -60,32 +95,11 @@ def make_train_step(
     # Spatial partitioning shards feature heights over the model axis — a
     # layout the per-shard kernel contract doesn't cover — so those runs
     # keep mesh=None here and the XLA path (see mesh_safe_model_cfg).
+    # Inside the accumulation shard_map the step is ALREADY per-shard, so
+    # the kernel runs its single-device form there too.
     roi_mesh = mesh if (mesh is not None and not spatial) else None
 
-    def step(state: TrainState, batch: Batch):
-        if spatial_spec is not None:
-            batch = batch._replace(
-                images=jax.lax.with_sharding_constraint(
-                    batch.images, spatial_spec
-                )
-            )
-        rng = jax.random.fold_in(state.rng, state.step)
-
-        def loss_fn(params):
-            if trainable_mask is not None:
-                params = jax.tree_util.tree_map(
-                    lambda p, t: p if t else jax.lax.stop_gradient(p),
-                    params,
-                    trainable_mask,
-                )
-            variables = {"params": params, **state.model_state}
-            total, metrics = forward_train(
-                model, variables, rng, batch, mesh=roi_mesh,
-                pixel_stats=pixel_stats,
-            )
-            return total, metrics
-
-        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+    def _finish(state: TrainState, grads, metrics):
         with jax.named_scope("guardian"):
             # On-device finiteness reduction (train/guardian.py): ONE 0/1
             # scalar covering the gradient global norm (inf/NaN anywhere
@@ -103,6 +117,35 @@ def make_train_step(
         if schedule is not None:
             metrics["lr"] = schedule(state.step)
         return new_state, metrics
+
+    def _masked(params):
+        if trainable_mask is None:
+            return params
+        return jax.tree_util.tree_map(
+            lambda p, t: p if t else jax.lax.stop_gradient(p),
+            params,
+            trainable_mask,
+        )
+
+    def step(state: TrainState, batch: Batch):
+        if spatial_spec is not None:
+            batch = batch._replace(
+                images=jax.lax.with_sharding_constraint(
+                    batch.images, spatial_spec
+                )
+            )
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": _masked(params), **state.model_state}
+            total, metrics = forward_train(
+                model, variables, rng, batch, mesh=roi_mesh,
+                pixel_stats=pixel_stats,
+            )
+            return total, metrics
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
+        return _finish(state, grads, metrics)
 
     def multi_step(state: TrainState, batches: Batch):
         # The host-side step loop, moved on-device: scan over the leading
@@ -123,30 +166,101 @@ def make_train_step(
             metrics["lr"] = mets["lr"][-1]
         return new_state, metrics
 
-    fn = multi_step if stacked else step
-    if mesh is None:
-        return jax.jit(fn, donate_argnums=(0,))
-    rep = replicated(mesh)
-    data = batch_sharding(mesh, stacked=stacked)
-    img = (
-        spatial_sharding(mesh, stacked=stacked)
-        if spatial_spec is not None
-        else data
-    )
-    # Per-field batch shardings (a pytree prefix): images may be spatially
-    # sharded; a prefix leaf over Batch's optional None fields applies to
-    # zero leaves, which is fine.
-    batch_shardings = Batch(
-        images=img,
-        image_hw=data, gt_boxes=data, gt_classes=data, gt_valid=data,
-        gt_masks=data, gt_ignore=data, ext_rois=data, ext_valid=data,
-    )
-    return jax.jit(
-        fn,
-        in_shardings=(rep, batch_shardings),
-        out_shardings=(rep, rep),
-        donate_argnums=(0,),
-    )
+    # --- gradient accumulation (accum_steps > 1) -------------------------
+    # f32 accumulators: grads are cast to the precision policy's accum
+    # dtype before summing, divided by N, then cast back to the param
+    # dtype for the optimizer (a no-op with f32 masters).
+    acc_dtype = policy_of(model.cfg).accum_dtype
+
+    def _accum_local(params, model_state, batches, a_keys, s_keys):
+        """Mean grads/metrics over the N stacked microbatches.
+
+        Runs per-shard inside the accumulation shard_map when a mesh is
+        present (batches/keys then hold this shard's rows), or on the
+        whole batch off-mesh.  Losses normalize by each microbatch's own
+        sampled-anchor/roi count, so the mean over microbatches equals
+        the monolithic big-batch loss exactly when every image meets its
+        sampling quota (the usual case) and to normalizer-weighting
+        round-off otherwise — the documented accumulation contract
+        (docs/scaling.md).
+        """
+        n = batches.images.shape[0]
+
+        def loss_fn(p, mb, ak, sk):
+            variables = {"params": _masked(p), **model_state}
+            return forward_train(
+                model, variables, None, mb, mesh=None,
+                pixel_stats=pixel_stats, rngs=(ak, sk),
+            )
+
+        def body(g_acc, xs):
+            mb, ak, sk = xs
+            grads, metrics = jax.grad(loss_fn, has_aux=True)(
+                params, mb, ak, sk
+            )
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(acc_dtype), g_acc, grads
+            )
+            return g_acc, metrics
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, acc_dtype), params
+        )
+        g_sum, mets = jax.lax.scan(body, g0, (batches, a_keys, s_keys))
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / n).astype(p.dtype), g_sum, params
+        )
+        metrics = jax.tree_util.tree_map(
+            lambda m: jnp.mean(m.astype(jnp.float32), axis=0), mets
+        )
+        return grads, metrics
+
+    def _accum_psum(params, model_state, batches, a_keys, s_keys):
+        # Per-shard local means, ONE all-reduce per optimizer step — the
+        # reason this is shard_map and not jit+GSPMD (which would
+        # all-reduce the replicated scan carry every microbatch).
+        grads, metrics = _accum_local(
+            params, model_state, batches, a_keys, s_keys
+        )
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        metrics = jax.lax.pmean(metrics, DATA_AXIS)
+        return grads, metrics
+
+    def accum_step(state: TrainState, batches: Batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+        rng_assign, rng_sample = jax.random.split(rng)
+        n, b = batches.images.shape[0], batches.images.shape[1]
+        if b % plan.data_shards:
+            raise ValueError(
+                f"microbatch size {b} not divisible by the data axis "
+                f"({plan.data_shards} shards)"
+            )
+        # Keys for the FULL global batch, sliced (N, B): microbatch j gets
+        # the rows a monolithic (N*B,) batch would hand images jB..jB+B-1.
+        a_keys = jax.random.split(rng_assign, n * b).reshape(n, b, -1)
+        s_keys = jax.random.split(rng_sample, n * b).reshape(n, b, -1)
+        if mesh is None:
+            grads, metrics = _accum_local(
+                state.params, state.model_state, batches, a_keys, s_keys
+            )
+        else:
+            kspec = P(None, DATA_AXIS)
+            grads, metrics = shard_map(
+                _accum_psum,
+                mesh=mesh,
+                in_specs=(P(), P(), plan.batch_specs(), kspec, kspec),
+                out_specs=(P(), P()),
+                check_rep=False,
+            )(state.params, state.model_state, batches, a_keys, s_keys)
+        return _finish(state, grads, metrics)
+
+    if plan.accum_steps > 1:
+        fn = accum_step
+    elif plan.steps_per_call > 1:
+        fn = multi_step
+    else:
+        fn = step
+    return plan.compile_step(fn, state_template=state_template)
 
 
 def mesh_safe_model_cfg(model_cfg, mesh, spatial: bool = False):
@@ -185,26 +299,22 @@ def mesh_safe_model_cfg(model_cfg, mesh, spatial: bool = False):
 
 
 def make_sharded_infer(
-    fn, mesh: Optional[Mesh] = None, gather_outputs: bool = False
+    fn, mesh: Optional[Mesh] = None, gather_outputs: bool = False,
+    plan: Optional[ExecutionPlan] = None,
 ):
     """Jit an inference-shaped ``fn(variables, batch)`` for the mesh:
     replicated params, data-sharded batch.  The one scaffolding shared by
-    eval, proposal dumps, and any future read-only pass.
+    eval, proposal dumps, and any future read-only pass — all via
+    :meth:`ExecutionPlan.compile_infer`, the same plan the train step
+    compiles through.
 
     ``gather_outputs``: replicate the outputs across the mesh (an XLA
     all-gather at the step's end).  Multi-host runs need it — a host can
     only ``device_get`` what it addresses, and detection/proposal outputs
     are tiny next to the step's compute."""
-    if mesh is None:
-        return jax.jit(fn)
-    rep, data = replicated(mesh), batch_sharding(mesh)
-    # out_shardings is a single spec broadcast over the output pytree
-    # (a tuple here would be matched structurally and fail).
-    return jax.jit(
-        fn,
-        in_shardings=(rep, data),
-        out_shardings=rep if gather_outputs else data,
-    )
+    if plan is None:
+        plan = ExecutionPlan(mesh=mesh)
+    return plan.compile_infer(fn, gather_outputs=gather_outputs)
 
 
 def make_eval_step(
@@ -212,6 +322,7 @@ def make_eval_step(
     mesh: Optional[Mesh] = None,
     gather_outputs: bool = False,
     pixel_stats=None,
+    plan: Optional[ExecutionPlan] = None,
 ):
     """Build ``eval_step(variables, batch) -> Detections`` (jitted)."""
 
@@ -220,7 +331,7 @@ def make_eval_step(
             model, variables, batch, mesh=mesh, pixel_stats=pixel_stats
         )
 
-    return make_sharded_infer(step, mesh, gather_outputs)
+    return make_sharded_infer(step, mesh, gather_outputs, plan=plan)
 
 
 def eval_variables(state: TrainState) -> dict:

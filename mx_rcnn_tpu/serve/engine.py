@@ -180,17 +180,24 @@ class DetectorRunner:
         )
 
         # One jitted callable per MODE; buckets become distinct XLA
-        # programs of the same callable (different static shapes).
+        # programs of the same callable (different static shapes).  All
+        # compile through the execution plan (parallel/plan.py) — the
+        # same scaffolding the train/eval steps use; serving runs the
+        # plan's mesh-less form (plain jit) today, and a sharded server
+        # is one ``mesh=`` away rather than a rewrite.
+        from mx_rcnn_tpu.parallel.plan import ExecutionPlan
+
+        plan = ExecutionPlan(mesh=None)
         self._steps = {
-            "full": jax.jit(
+            "full": plan.compile_infer(
                 lambda v, b: forward_inference(model, v, b, pixel_stats=stats)
             ),
-            "reduced": jax.jit(
+            "reduced": plan.compile_infer(
                 lambda v, b: forward_inference(
                     reduced_model, v, b, pixel_stats=stats
                 )
             ),
-            "proposals": jax.jit(
+            "proposals": plan.compile_infer(
                 lambda v, b: forward_proposals(model, v, b, pixel_stats=stats)
             ),
         }
@@ -204,7 +211,9 @@ class DetectorRunner:
             # The quantized tree rides as a jit ARGUMENT (device buffers),
             # not a closure — same request-size reasoning as _variables.
             self._box_q8 = jax.device_put(quantize_box_head(variables))
-            q8_step = jax.jit(
+            # Mesh-less plan compile == plain jit, so the extra quantized
+            # operand is fine; a sharded plan would need its own spec.
+            q8_step = plan.compile_infer(
                 lambda v, q, b: forward_inference(
                     model, v, b, pixel_stats=stats,
                     box_head_apply=lambda pooled: apply_box_head_q8(

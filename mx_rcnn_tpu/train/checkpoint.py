@@ -181,7 +181,7 @@ def finite_state(state) -> bool:
     return True
 
 
-def _abstract_target(target):
+def _abstract_target(target, shardings=None):
     def _abstract(x):
         if isinstance(x, jax.ShapeDtypeStruct):
             # Callers that build the target under jax.eval_shape (eval/demo
@@ -192,7 +192,18 @@ def _abstract_target(target):
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
         return ocp.utils.to_shape_dtype_struct(x)
 
-    return jax.tree_util.tree_map(_abstract, target)
+    abstract = jax.tree_util.tree_map(_abstract, target)
+    if shardings is None:
+        return abstract
+    # Plan-aware restore (parallel/plan.py): a per-leaf sharding pytree
+    # makes orbax place each restored array straight onto its device
+    # layout — a resumed pod run never round-trips through a
+    # host-replicated intermediate.
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract,
+        shardings,
+    )
 
 
 def restore_checkpoint(
@@ -202,6 +213,7 @@ def restore_checkpoint(
     *,
     max_step: Optional[int] = None,
     validate: Optional[Callable[[TrainState], bool]] = None,
+    shardings=None,
 ) -> TrainState:
     """Restore into the structure of ``target`` (shapes/dtypes from it).
 
@@ -210,10 +222,12 @@ def restore_checkpoint(
     truncated/corrupt on disk or fails ``validate`` — a partial write of
     the latest checkpoint must cost one checkpoint interval, not the run.
     An explicit ``step`` disables the fallback walk (the caller asked for
-    exactly that checkpoint).
+    exactly that checkpoint).  ``shardings``: optional per-leaf sharding
+    pytree (the execution plan's rule match) — arrays restore directly to
+    their device layout.
     """
     mgr = _manager(ckpt_dir)
-    abstract = _abstract_target(target)
+    abstract = _abstract_target(target, shardings=shardings)
     if step is not None:
         candidates = [step]
     else:

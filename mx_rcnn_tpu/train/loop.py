@@ -23,10 +23,10 @@ from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data import DetectionLoader, build_dataset, filter_roidb
 from mx_rcnn_tpu.detection import TwoStageDetector
 from mx_rcnn_tpu.parallel import (
+    PrefetchStats,
     device_prefetch,
     make_mesh,
     make_train_step,
-    replicated,
 )
 from mx_rcnn_tpu.parallel.mesh import MODEL_AXIS
 from mx_rcnn_tpu.train.checkpoint import (
@@ -114,8 +114,12 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
                 f"make_mesh(model_parallel={sp})"
             )
     # With spatial partitioning, `sp` chips cooperate on each image: the
-    # data axis shrinks by sp, and so does the global batch.
-    global_batch = cfg.train.per_device_batch * (n_dev // sp)
+    # data axis shrinks by sp, and so does the global batch.  Gradient
+    # accumulation multiplies it back up: one optimizer step sees
+    # accum_steps microbatches, so the EFFECTIVE global batch (what the
+    # linear-scaling rule and the img/s meter care about) includes it.
+    accum = cfg.train.accum_steps
+    global_batch = cfg.train.per_device_batch * (n_dev // sp) * accum
     # Linear-scaling rule, both halves: lr scales UP by global_batch/ref
     # and the step-denominated schedule scales DOWN by ref/global_batch,
     # so any pod size trains the same epochs (reference drivers:
@@ -162,12 +166,37 @@ def build_all(cfg: Config, mesh=None, freeze_backbone: bool = True,
         trainable = frozen_mask(state.params, freeze)
     else:
         tx = probe_tx
+    # The execution plan (parallel/plan.py) owns every sharding decision
+    # from here on: it validates the knob combination, resolves the
+    # partition rules against the real state (unmatched leaf = hard error
+    # at build time), and compiles the step.  train() rebuilds the same
+    # plan (pure function of cfg+mesh) for state placement and restore.
+    plan = build_plan(cfg, mesh, model=model)
     step_fn = make_train_step(
-        model, tx, schedule, mesh=mesh, spatial=sp > 1,
-        trainable_mask=trainable, steps_per_call=cfg.train.steps_per_call,
+        model, tx, schedule, trainable_mask=trainable,
         pixel_stats=(cfg.data.pixel_mean, cfg.data.pixel_std),
+        plan=plan, state_template=state,
     )
     return model, tx, state, step_fn, global_batch
+
+
+def build_plan(cfg: Config, mesh=None, model: Optional[TwoStageDetector] = None):
+    """The config's ExecutionPlan — shared by build_all and train()."""
+    from mx_rcnn_tpu.parallel.plan import ExecutionPlan
+    from mx_rcnn_tpu.parallel.step import mesh_safe_model_cfg
+
+    if model is None:
+        model_cfg = mesh_safe_model_cfg(
+            cfg.model, mesh, spatial=cfg.train.spatial_partition > 1
+        )
+        model = TwoStageDetector(cfg=model_cfg)
+    return ExecutionPlan.for_model(
+        model,
+        mesh=mesh,
+        spatial=cfg.train.spatial_partition > 1,
+        accum_steps=cfg.train.accum_steps,
+        steps_per_call=cfg.train.steps_per_call,
+    )
 
 
 def _flat_config(d: dict, prefix: str = "") -> dict:
@@ -277,6 +306,11 @@ def train(
     model, tx, fresh_state, step_fn, global_batch = build_all(
         cfg, mesh, extra_freeze=extra_freeze, pretrained=pretrained
     )
+    plan = build_plan(cfg, mesh, model=model)
+    accum = cfg.train.accum_steps
+    from mx_rcnn_tpu.parallel.distributed import describe_plan
+
+    log.info(describe_plan(plan))
     if state is None:
         state = fresh_state
     else:
@@ -300,7 +334,10 @@ def train(
         # Restore validates finiteness and falls back past a truncated or
         # corrupt latest checkpoint (a kill mid-write costs one checkpoint
         # interval, not the run).
-        state = restore_checkpoint(ckpt_dir, state, validate=finite_state)
+        state = restore_checkpoint(
+            ckpt_dir, state, validate=finite_state,
+            shardings=plan.state_shardings(state),
+        )
         log.info("resumed from %s at step %d", ckpt_dir, int(state.step))
         _warn_config_drift(
             cfg, f"{workdir or cfg.workdir}/{cfg.name}/config.json",
@@ -315,7 +352,10 @@ def train(
         loader = DetectionLoader(
             roidb,
             cfg.data,
-            batch_size=global_batch,
+            # Host batches are MICROBATCHES under gradient accumulation:
+            # one optimizer step consumes `accum` consecutive loader
+            # batches (stacked on the leading axis by _stacked_batches).
+            batch_size=global_batch // accum,
             train=True,
             seed=cfg.train.seed,
             rank=jax.process_index(),
@@ -323,17 +363,19 @@ def train(
             with_masks=cfg.model.mask.enabled,
             proposals=proposals,
             num_proposals=cfg.model.rpn.train_post_nms_top_n,
-            # Stacked steps_per_call calls scan K batches in one device
-            # program — the loader must emit K same-canvas batches per run.
-            run_length=max(cfg.train.steps_per_call, 1),
+            # Stacked steps_per_call / accum_steps calls scan K (or N)
+            # batches in one device program — the loader must emit that
+            # many same-canvas batches per run.
+            run_length=max(cfg.train.steps_per_call, accum, 1),
             # Unreadable images are retried, then quarantined to this jsonl
             # and deterministically substituted instead of killing the run.
             quarantine_path=(
                 f"{workdir}/{cfg.name}/quarantine.jsonl" if workdir else None
             ),
         )
-    if mesh is not None:
-        state = jax.device_put(state, replicated(mesh))
+    # Plan-directed placement (today: every rule is P() — replicated, the
+    # same layout `device_put(state, replicated(mesh))` produced).
+    state = plan.shard_state(state)
 
     speedo = Speedometer(global_batch)
     start = int(state.step)
@@ -371,20 +413,26 @@ def train(
     spatial = cfg.train.spatial_partition > 1
 
     def data_iter(from_step: int, extra_skip: int):
-        # Rebuilt after a guardian rollback: ``extra_skip`` batches of the
-        # global schedule are dropped so the retried steps see FRESH data
-        # (the offending window is skipped, not replayed).
-        host_it = loader.iter_from(skip_batches=from_step + extra_skip)
+        # Rebuilt after a guardian rollback: ``extra_skip`` optimizer
+        # steps' worth of the global schedule are dropped so the retried
+        # steps see FRESH data (the offending window is skipped, not
+        # replayed).  Both counts are in optimizer steps; an accumulated
+        # step consumes `accum` host microbatches, hence the scaling.
+        host_it = loader.iter_from(
+            skip_batches=(from_step + extra_skip) * accum
+        )
         if k > 1:
             host_it = _stacked_batches(host_it, k)
+        elif accum > 1:
+            host_it = _stacked_batches(host_it, accum)
         # host_depth=1: the one-step host double buffer — decode/augment/
         # stack for batch k+1 runs on a background thread while batch k's
         # step occupies the device, on top of the async device_put depth.
         # Batch ORDER is untouched, so the data schedule (and chaos
         # bit-exact resume) is identical to the synchronous pipeline.
         return device_prefetch(
-            host_it, mesh, depth=2, spatial=spatial, stacked=k > 1,
-            host_depth=1,
+            host_it, mesh, depth=2, spatial=spatial, stacked=plan.stacked,
+            host_depth=1, stats=prefetch_stats,
         )
 
     # Rollback safety net: make sure SOME checkpoint exists before the
@@ -417,6 +465,11 @@ def train(
         spike_zscore=cfg.train.guardian_spike_z,
     )
     pending: list[dict] = []
+    # Data-starvation meter: time the consumer blocked in next(loader)
+    # past the prefetch double buffer, logged per interval as
+    # data_stall_ms (per optimizer step) alongside the device metrics.
+    prefetch_stats = PrefetchStats()
+    last_drain = start
     it = data_iter(start, 0)
     data_skip = 0      # batches the guardian skipped ahead of the schedule
     last_good = start  # newest boundary whose drained metrics were finite
@@ -444,12 +497,18 @@ def train(
                 # every on-disk step is a sound rollback target.
                 means, per_step = host_interval_metrics(pending)
                 pending.clear()
+                # Host-side metric, appended AFTER the guardian sees the
+                # interval (a slow disk must never look like divergence).
+                stall_s, _ = prefetch_stats.take()
+                stall_ms = stall_s * 1000.0 / max(done - last_drain, 1)
+                last_drain = done
                 rollback = guardian.observe(done, means, per_step)
                 if rollback is not None:
                     target = jax.device_get(state)
                     state = restore_checkpoint(
                         ckpt_dir, target, max_step=last_good,
                         validate=finite_state,
+                        shardings=plan.state_shardings(target),
                     )
                     restored = int(state.step)
                     # A poisoned checkpoint newer than the rollback target
@@ -460,11 +519,7 @@ def train(
                     # host arrays, and the next step runs under
                     # transfer_guard('disallow') — implicit transfer would
                     # raise there.
-                    state = (
-                        jax.device_put(state, replicated(mesh))
-                        if mesh is not None
-                        else jax.device_put(state)
-                    )
+                    state = plan.shard_state(state)
                     # The retried window consumes the batches AFTER the
                     # offending one — skip forward, never replay poison.
                     data_skip += done - restored
@@ -473,6 +528,7 @@ def train(
                     if writer:
                         writer.truncate(restored)
                     speedo = Speedometer(global_batch)
+                    last_drain = restored
                     log.warning(
                         "guardian rollback: restored step %d, skipping %d "
                         "batch(es) of the data schedule (total skipped: %d)",
@@ -482,6 +538,7 @@ def train(
                     continue
                 last_good = done
                 means.pop("nonfinite", None)
+                means["data_stall_ms"] = stall_ms
                 if at_log:
                     speedo(done, means)
                     if writer:

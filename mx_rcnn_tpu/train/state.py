@@ -56,3 +56,31 @@ def create_train_state(
 def state_variables(state: TrainState) -> dict:
     """Rebuild the flax ``variables`` dict for model.apply."""
     return {"params": state.params, **state.model_state}
+
+
+def _key_name(k) -> str:
+    # DictKey(.key) for flax param dicts, GetAttrKey(.name) for struct
+    # dataclass fields, SequenceKey(.idx) for optax chain tuples.
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def leaf_paths(tree) -> list:
+    """``[(\"a/b/c\", leaf), ...]`` over a pytree, "/"-joined canonical names.
+
+    The naming contract the execution plan's regex partition rules match
+    against (parallel/plan.py).  It lives here, next to :class:`TrainState`,
+    because the names that matter are the state's: param-dict keys appear
+    verbatim inside optax wrapper paths (``.../trace/backbone/conv1/kernel``)
+    and BN stats (``batch_stats/backbone/...``), so one family rule covers a
+    parameter, its momentum, and its running stats at once.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [
+        ("/".join(_key_name(k) for k in path), leaf) for path, leaf in flat
+    ]

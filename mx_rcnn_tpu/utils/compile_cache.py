@@ -107,7 +107,42 @@ def _probe_blob() -> bytes:
     return ex.client.serialize_executable(ex)
 
 
-def cpu_fingerprint() -> str:
+def host_identity() -> str:
+    """A stable per-machine identifier, most-durable source first.
+
+    ``/etc/machine-id`` survives reboots; the kernel's ``boot_id`` at
+    least separates machines (it rotates per boot, costing warm-cache
+    reuse across reboots but never correctness); the hostname is the
+    last resort.  Used ONLY by strict-host mode below — it deliberately
+    over-separates (two genuinely identical hosts get distinct keys,
+    losing safe sharing), which is the right trade for harnesses that
+    spawn subprocess workers and cannot afford a foreign-blob replay.
+    """
+    for path in ("/etc/machine-id", "/var/lib/dbus/machine-id"):
+        try:
+            with open(path) as f:
+                mid = f.read().strip()
+            if mid:
+                return "machine-id:" + mid
+        except OSError:
+            pass
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            bid = f.read().strip()
+        if bid:
+            return "boot-id:" + bid
+    except OSError:
+        pass
+    import socket
+
+    return "hostname:" + socket.gethostname()
+
+
+def _strict_host_env() -> bool:
+    return os.environ.get("MX_RCNN_CACHE_STRICT_HOST", "") not in ("", "0")
+
+
+def cpu_fingerprint(strict_host: bool = False) -> str:
     """Stable-ish hash of this host's CPU identity and the compiler stack.
 
     The key mixes, in order of specificity:
@@ -147,6 +182,17 @@ def cpu_fingerprint() -> str:
     Note: strengthening this key (r4, again r5) intentionally orphans
     caches warmed under the previous key; first runs after the change pay
     a full recompile.
+
+    r7 ``strict_host`` (param, or env ``MX_RCNN_CACHE_STRICT_HOST=1`` so
+    spawned workers inherit it): when the AOT probe is unavailable —
+    jaxlib 0.4.x serializes nondeterministically, so
+    :func:`llvm_target_features` returns None and the key degrades to
+    exactly the cpuinfo proxy that MULTICHIP_r04/r05 showed colliding
+    across driver hosts — mix :func:`host_identity` into the key.  Each
+    host keeps a warm PER-HOST cache (strictly better than disabling
+    reuse) and a foreign host can never replay this host's blobs.  Off
+    by default: the tier-1 suite's long-lived cache on a single builder
+    would be orphaned by boot-id rotation for no safety gain there.
     """
     import jaxlib
 
@@ -176,11 +222,13 @@ def cpu_fingerprint() -> str:
         key = repr(platform.uname())
     feats = llvm_target_features()
     key += "\nllvm_target_features=" + (feats if feats is not None else "?")
+    if feats is None and (strict_host or _strict_host_env()):
+        key += "\nhost=" + host_identity()
     key += "\njaxlib=" + jaxlib.version.__version__
     return hashlib.sha1(key.encode()).hexdigest()[:8]
 
 
-def backend_fingerprint() -> str:
+def backend_fingerprint(strict_host: bool = False) -> str:
     """Cache-key fingerprint for WHATEVER backend jax initialized.
 
     - cpu: :func:`cpu_fingerprint` — XLA:CPU AOT blobs are codegen'd for
@@ -197,7 +245,7 @@ def backend_fingerprint() -> str:
 
     backend = jax.default_backend()
     if backend == "cpu":
-        return cpu_fingerprint()
+        return cpu_fingerprint(strict_host=strict_host)
     import jaxlib
 
     dev = jax.devices()[0]
@@ -218,24 +266,30 @@ def backend_fingerprint() -> str:
     return backend + "-" + hashlib.sha1(key.encode()).hexdigest()[:8]
 
 
-def configure_cache(cache_root: str, min_compile_secs: float = 5.0) -> str:
+def configure_cache(cache_root: str, min_compile_secs: float = 5.0,
+                    strict_host: bool = False) -> str:
     """Point jax's persistent compile cache at a fingerprinted subdir.
 
     Generalized form of :func:`configure_cpu_cache`: keys ``cache_root``
     by :func:`backend_fingerprint` so one checkout shared across hosts /
     chip generations never replays a foreign executable, with the same
-    keep-newest-3 sibling pruning.  Call after the backend is decided
-    (importing jax is fine; the first ``jax.devices()`` call here
-    initializes it).  Returns the directory used.
+    keep-newest-3 sibling pruning.  ``strict_host`` (or env
+    ``MX_RCNN_CACHE_STRICT_HOST=1``) additionally separates hosts when
+    the LLVM-feature probe is unavailable — see :func:`cpu_fingerprint`.
+    Call after the backend is decided (importing jax is fine; the first
+    ``jax.devices()`` call here initializes it).  Returns the directory
+    used.
     """
     import jax
 
-    cache_dir = os.path.join(cache_root, backend_fingerprint())
+    cache_dir = os.path.join(
+        cache_root, backend_fingerprint(strict_host=strict_host)
+    )
     _prune_and_point(jax, cache_root, cache_dir, min_compile_secs)
     return cache_dir
 
 
-def configure_cpu_cache(repo_root: str) -> str:
+def configure_cpu_cache(repo_root: str, strict_host: bool = False) -> str:
     """Point jax's persistent compile cache at the shared fingerprinted dir.
 
     Call only after the caller has pinned the platform to CPU (the cache
@@ -244,7 +298,7 @@ def configure_cpu_cache(repo_root: str) -> str:
     import jax
 
     cache_root = os.path.join(repo_root, "tests", ".jax_cache")
-    cache_dir = os.path.join(cache_root, cpu_fingerprint())
+    cache_dir = os.path.join(cache_root, cpu_fingerprint(strict_host=strict_host))
     _prune_and_point(jax, cache_root, cache_dir, 5.0)
     return cache_dir
 

@@ -100,6 +100,71 @@ class TestBlobFallback:
         assert compile_cache.cpu_fingerprint() != base
 
 
+class TestStrictHostKey:
+    """r7 strict-host mode: when the LLVM probe degrades (jaxlib 0.4.x
+    serializes nondeterministically), the cpuinfo proxy is the only key
+    left — and r3/r4 proved it can collide across hosts.  Harnesses that
+    spawn subprocess workers (driver dryrun, perf_breakdown, bench) mix a
+    per-machine identity into the key so a foreign XLA:CPU AOT blob can
+    never be replayed (the cpu_aot_loader SIGILL tail in MULTICHIP_r04)."""
+
+    def test_host_identity_sourced_and_stable(self):
+        hid = compile_cache.host_identity()
+        assert hid.split(":", 1)[0] in ("machine-id", "boot-id", "hostname")
+        assert len(hid.split(":", 1)[1]) > 0
+        assert hid == compile_cache.host_identity()
+
+    def test_strict_host_separates_keys_when_probe_degrades(self, monkeypatch):
+        monkeypatch.delenv("MX_RCNN_CACHE_STRICT_HOST", raising=False)
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features", lambda: None
+        )
+        assert (
+            compile_cache.cpu_fingerprint(strict_host=True)
+            != compile_cache.cpu_fingerprint()
+        )
+
+    def test_strict_host_noop_with_a_live_probe(self, monkeypatch):
+        # With real LLVM features in the key the proxy never engages, so
+        # strict mode must not orphan warm caches on healthy hosts.
+        monkeypatch.delenv("MX_RCNN_CACHE_STRICT_HOST", raising=False)
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features",
+            lambda: "+64bit,+avx,+avx2,+fma",
+        )
+        assert (
+            compile_cache.cpu_fingerprint(strict_host=True)
+            == compile_cache.cpu_fingerprint()
+        )
+
+    def test_env_var_engages_strict_mode(self, monkeypatch):
+        # The subprocess channel: the dryrun driver exports
+        # MX_RCNN_CACHE_STRICT_HOST=1 instead of threading a kwarg
+        # through every worker entry point.
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features", lambda: None
+        )
+        monkeypatch.delenv("MX_RCNN_CACHE_STRICT_HOST", raising=False)
+        base = compile_cache.cpu_fingerprint()
+        monkeypatch.setenv("MX_RCNN_CACHE_STRICT_HOST", "1")
+        assert compile_cache.cpu_fingerprint() != base
+        assert compile_cache.cpu_fingerprint() == compile_cache.cpu_fingerprint(
+            strict_host=True
+        )
+        monkeypatch.setenv("MX_RCNN_CACHE_STRICT_HOST", "0")
+        assert compile_cache.cpu_fingerprint() == base
+
+    def test_backend_fingerprint_threads_strict_through(self, monkeypatch):
+        monkeypatch.delenv("MX_RCNN_CACHE_STRICT_HOST", raising=False)
+        monkeypatch.setattr(
+            compile_cache, "llvm_target_features", lambda: None
+        )
+        assert (
+            compile_cache.backend_fingerprint(strict_host=True)
+            != compile_cache.backend_fingerprint()
+        )
+
+
 class TestBackendFingerprint:
     """The generalized key bench.py/perf_breakdown.py now use: same
     SIGILL-proofing as the CPU-only key, but correct on accelerators too

@@ -1,0 +1,477 @@
+"""Execution-plan tests (parallel/plan.py, ISSUE 7).
+
+Four contracts:
+
+- **Rule coverage** — every leaf of a REAL TrainState resolves through
+  the regex partition rules; an unmatched non-scalar leaf is a hard
+  build-time error naming the path (a new head trained under an
+  accidental default layout is the failure this guards).
+- **Accumulation parity** — ``accum_steps=1`` is bit-identical to the
+  plain step (same trace), and ``accum_steps∈{2,4}`` matches one
+  monolithic big-batch step to f32 accumulation round-off (per-image
+  rng keys are derived for the full global batch and sliced, so the
+  sampled anchors/rois per image are identical — see step.py).
+- **Donation** — the plan-compiled step aliases every state buffer
+  in-place (params update in HBM, no double residency).
+- **Bit-exact resume** — a checkpoint round-trip mid-run through the
+  plan-built accumulation step changes nothing, extending the PR-3
+  chaos guarantee to the accumulation path.
+"""
+
+import dataclasses
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mx_rcnn_tpu.config import get_config
+from mx_rcnn_tpu.detection import TwoStageDetector
+from mx_rcnn_tpu.parallel import (
+    ExecutionPlan,
+    PrefetchStats,
+    family_rules,
+    make_mesh,
+    make_train_step,
+    match_partition_rules,
+    shard_batch,
+)
+from mx_rcnn_tpu.parallel.prefetch import device_prefetch
+from mx_rcnn_tpu.train import create_train_state, make_optimizer
+
+
+def _leaves_with_paths(tree):
+    return jax.tree_util.tree_flatten_with_path(tree)[0]
+
+
+def _assert_trees_bitwise_equal(a, b, what=""):
+    fa, fb = _leaves_with_paths(a), _leaves_with_paths(b)
+    assert len(fa) == len(fb)
+    for (pa, la), (pb, lb) in zip(fa, fb):
+        assert pa == pb
+        la, lb = np.asarray(la), np.asarray(lb)
+        assert la.dtype == lb.dtype, f"{what}{pa}: {la.dtype} != {lb.dtype}"
+        nan_ok = np.issubdtype(la.dtype, np.floating)
+        assert np.array_equal(la, lb, equal_nan=nan_ok), (
+            f"{what}{jax.tree_util.keystr(pa)} differs bitwise"
+        )
+
+
+class TestPartitionRules:
+    def test_scalars_and_size1_replicate_without_rules(self):
+        tree = {
+            "step": jnp.zeros((), jnp.int32),
+            "count": jnp.zeros((1,), jnp.int32),
+        }
+        specs = match_partition_rules((), tree)
+        assert specs["step"] == P()
+        assert specs["count"] == P()
+
+    def test_one_family_rule_covers_param_momentum_and_stats(self):
+        # The path vocabulary the docstring promises: the same "backbone"
+        # rule must hit the parameter, its optax momentum (wrapper path),
+        # and its BN stats — plus the non-scalar rng key.
+        rules = family_rules(["backbone", "rpn"])
+        k = jnp.zeros((3, 3, 3, 8))
+        tree = {
+            "params": {"backbone": {"conv1": {"kernel": k}}},
+            "opt_state": {"trace": {"backbone": {"conv1": {"kernel": k}}}},
+            "model_state": {
+                "batch_stats": {"backbone": {"bn1": {"mean": jnp.zeros(8)}}}
+            },
+            "rng": jnp.zeros((2,), jnp.uint32),
+        }
+        specs = match_partition_rules(rules, tree)
+        flat = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        assert len(flat) == len(jax.tree_util.tree_leaves(tree))
+        assert all(s == P() for s in flat)
+
+    def test_unmatched_leaf_is_a_hard_error(self):
+        rules = family_rules(["backbone"])
+        tree = {"params": {"new_head": {"kernel": jnp.zeros((4, 4))}}}
+        with pytest.raises(ValueError, match="new_head"):
+            match_partition_rules(rules, tree)
+
+    def test_family_match_is_path_anchored(self):
+        # "rpn" must not substring-match a hypothetical "some_rpn_like".
+        rules = family_rules(["rpn"])
+        tree = {"params": {"some_rpn_like": {"kernel": jnp.zeros((4, 4))}}}
+        with pytest.raises(ValueError, match="some_rpn_like"):
+            match_partition_rules(rules, tree)
+
+    def test_first_matching_rule_wins(self):
+        rules = (
+            (r"(^|/)backbone/", P("data")),
+            (r"kernel$", P()),
+        )
+        tree = {"backbone": {"kernel": jnp.zeros((4, 4))}}
+        specs = match_partition_rules(rules, tree)
+        assert specs["backbone"]["kernel"] == P("data")
+
+    def test_real_state_every_leaf_resolves(self, built):
+        plan = ExecutionPlan.for_model(built.model)
+        specs = plan.state_specs(built.host)
+        flat = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        n_leaves = len(jax.tree_util.tree_leaves(built.host))
+        assert len(flat) == n_leaves
+        # Pure DP today: every rule resolves to replicate.
+        assert all(s == P() for s in flat)
+
+
+class TestPlanValidation:
+    def test_accum_and_steps_per_call_exclusive(self):
+        with pytest.raises(ValueError, match="pick one"):
+            ExecutionPlan(accum_steps=2, steps_per_call=2)
+
+    def test_spatial_needs_mesh(self):
+        with pytest.raises(ValueError, match="mesh"):
+            ExecutionPlan(spatial=True)
+
+    def test_spatial_excludes_accum(self):
+        with pytest.raises(ValueError, match="incompatible"):
+            ExecutionPlan(mesh=make_mesh(), spatial=True, accum_steps=2)
+
+    def test_nonpositive_knobs_rejected(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            ExecutionPlan(accum_steps=0)
+
+    def test_step_shape_properties(self):
+        assert not ExecutionPlan().stacked
+        p = ExecutionPlan(accum_steps=4)
+        assert p.stacked and not p.use_shard_map and p.data_shards == 1
+        q = ExecutionPlan(mesh=make_mesh(), accum_steps=4)
+        assert q.stacked and q.use_shard_map
+        assert q.data_shards == q.mesh.shape["data"]
+        r = ExecutionPlan(steps_per_call=3)
+        assert r.stacked and not r.use_shard_map
+
+
+@pytest.fixture(scope="module")
+def built():
+    """One tiny model + optimizer + host-resident step-0 state, plus a
+    per-accum-steps cache of compiled (mesh-less) train steps.  Donation
+    deletes whatever device view a test feeds a step, so tests must
+    ``jax.device_put(built.host)`` a FRESH copy per run — never share.
+    """
+    cfg = get_config("tiny_synthetic")
+    # 64px canvas (the perf_breakdown CI smoke's): the parity and resume
+    # tests below EXECUTE full train steps on one CPU core, and step cost
+    # scales with canvas area — at the preset's native 128px this file
+    # alone blows the tier-1 time budget.
+    # allowed_border widens because at 64px nearly every anchor (32–512px
+    # bases) crosses the boundary: with the default 0 the in-image bg
+    # candidate pool drops below the 64-anchor sampling quota and VARIES
+    # per image, which breaks the accumulation-parity precondition
+    # (constant loss normalizers — docs/scaling.md); with the full grid
+    # admitted the sampler saturates its quota on every image.
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model,
+            rpn=dataclasses.replace(cfg.model.rpn, allowed_border=1000.0),
+        ),
+        data=dataclasses.replace(
+            cfg.data, image_size=(64, 64), short_side=64, max_side=64
+        ),
+    )
+    model = TwoStageDetector(cfg=cfg.model)
+    tx, schedule = make_optimizer(cfg.train, None)
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), cfg.data.image_size, batch=1
+    )
+    host = jax.device_get(state)
+    pixel_stats = (cfg.data.pixel_mean, cfg.data.pixel_std)
+    steps = {}
+
+    def step_for(accum):
+        if accum not in steps:
+            steps[accum] = make_train_step(
+                model, tx, schedule, accum_steps=accum,
+                pixel_stats=pixel_stats,
+            )
+        return steps[accum]
+
+    return SimpleNamespace(
+        cfg=cfg, model=model, tx=tx, schedule=schedule, host=host,
+        pixel_stats=pixel_stats, step_for=step_for,
+    )
+
+
+def _batches(cfg, n, b):
+    """n microbatches of b images: stacked (n, b, ...) when n > 1, flat
+    (b, ...) at n=1.  A fixed seed draws the SAME pixel and box stream
+    for equal n*b, so the stacked form is exactly the flat batch
+    reshaped — one of the parity oracle's two preconditions.
+
+    The other: every image must SAMPLE ITS FULL anchor/roi quota so the
+    loss normalizers are constants (the documented exactness condition,
+    docs/scaling.md).  bench's generator collapses all boxes to the
+    origin at a 64px canvas (``uniform(0, w-64)``) and piles them up —
+    many anchors land in the 0.3–0.7 IoU dead zone (neither fg nor bg),
+    the candidate pool shrinks below the quota, and per-image sampled
+    counts vary, which genuinely perturbs microbatch-mean vs
+    big-batch-mean.  Small sparse boxes keep every anchor's IoU cleanly
+    below the bg threshold, so the sampler always fills its quota.
+    """
+    from mx_rcnn_tpu.detection import Batch
+
+    rng = np.random.RandomState(0)
+    h, w = cfg.data.image_size
+    g = cfg.data.max_gt_boxes
+    n_gt = min(8, g)
+    total = n * b
+    boxes = np.zeros((total, g, 4), np.float32)
+    for i in range(total):
+        bw = rng.uniform(w // 8, w // 4, n_gt)
+        bh = rng.uniform(h // 8, h // 4, n_gt)
+        x1 = rng.uniform(0, w - bw)
+        y1 = rng.uniform(0, h - bh)
+        boxes[i, :n_gt] = np.stack([x1, y1, x1 + bw, y1 + bh], axis=1)
+    classes = np.zeros((total, g), np.int32)
+    classes[:, :n_gt] = rng.randint(1, cfg.model.num_classes, (total, n_gt))
+    valid = np.zeros((total, g), bool)
+    valid[:, :n_gt] = True
+    images = rng.randint(0, 256, (total, h, w, 3), dtype=np.uint8)
+    batch = Batch(
+        images=images,
+        image_hw=np.tile(
+            np.asarray([[float(h), float(w)]], np.float32), (total, 1)
+        ),
+        gt_boxes=boxes,
+        gt_classes=classes,
+        gt_valid=valid,
+    )
+    if n > 1:
+        batch = Batch(*[
+            None if f is None else f.reshape(n, b, *f.shape[1:])
+            for f in batch
+        ])
+    return batch
+
+
+class TestAccumParity:
+    def test_stacked_batches_are_the_flat_batch_reshaped(self, built):
+        flat = _batches(built.cfg, 1, 4)
+        stacked = _batches(built.cfg, 2, 2)
+        np.testing.assert_array_equal(
+            np.asarray(stacked.images).reshape(flat.images.shape),
+            flat.images,
+        )
+
+    @pytest.mark.slow  # executes full train steps (CI multichip smoke)
+    def test_accum1_is_bitwise_the_plain_step(self, built):
+        # accum_steps=1 must select the plain step body (the SAME trace
+        # the chaos harness proved bit-exact-resumable), so a fresh
+        # compile with the knob explicitly at 1 is bitwise the default.
+        batch = _batches(built.cfg, 1, 4)
+        s_default, m_default = built.step_for(1)(
+            jax.device_put(built.host), batch
+        )
+        explicit = make_train_step(
+            built.model, built.tx, built.schedule, accum_steps=1,
+            pixel_stats=built.pixel_stats,
+        )
+        s_explicit, m_explicit = explicit(jax.device_put(built.host), batch)
+        _assert_trees_bitwise_equal(
+            jax.device_get(s_default), jax.device_get(s_explicit), "state:"
+        )
+        _assert_trees_bitwise_equal(
+            jax.device_get(m_default), jax.device_get(m_explicit), "metrics:"
+        )
+
+    @pytest.mark.slow  # executes full train steps (CI multichip smoke)
+    @pytest.mark.parametrize("accum", [2, 4])
+    def test_accum_matches_flat_big_batch(self, built, accum):
+        n_images = 4
+        flat = _batches(built.cfg, 1, n_images)
+        stacked = _batches(built.cfg, accum, n_images // accum)
+        s_flat, m_flat = built.step_for(1)(jax.device_put(built.host), flat)
+        s_acc, m_acc = built.step_for(accum)(
+            jax.device_put(built.host), stacked
+        )
+        m_flat, m_acc = jax.device_get((m_flat, m_acc))
+        assert set(m_flat) == set(m_acc)
+        for key in m_flat:
+            # The Acc metrics threshold near-zero logits (pred = logit >
+            # 0), and at init the untrained heads put MANY samples within
+            # f32 round-off of that boundary — the batch-4 and scanned
+            # batch-1 conv compilations reduce in different orders, so a
+            # few hairline predictions legitimately flip.  Continuous
+            # quantities (losses, params) are held to round-off; the 0/1
+            # counters just get a few-flips allowance (5/256 samples).
+            tol = (
+                dict(rtol=0.0, atol=0.02)
+                if key.endswith("Acc")
+                else dict(rtol=1e-4, atol=1e-5)
+            )
+            np.testing.assert_allclose(
+                m_acc[key], m_flat[key],
+                err_msg=f"metric {key!r} (accum={accum})", **tol,
+            )
+        # Params agree to f32 accumulation round-off — NOT bitwise: the
+        # accumulated grads sum per-microbatch means in f32 and divide
+        # once, a different summation order than one big batch.
+        fa = _leaves_with_paths(jax.device_get(s_acc.params))
+        fb = _leaves_with_paths(jax.device_get(s_flat.params))
+        for (pa, la), (_, lb) in zip(fa, fb):
+            np.testing.assert_allclose(
+                la, lb, rtol=1e-5, atol=2e-6,
+                err_msg=f"param {jax.tree_util.keystr(pa)} (accum={accum})",
+            )
+        assert int(s_acc.step) == 1  # N microbatches = ONE optimizer step
+
+    def test_microbatch_must_divide_data_axis(self, built):
+        # Off-mesh anything divides; the shard-count check is plan logic
+        # (exercised compiled on the mesh in TestPlanOnMesh) — here the
+        # eager error path: 8 shards cannot split a 3-image microbatch.
+        mesh = make_mesh()
+        plan = ExecutionPlan.for_model(built.model, mesh=mesh, accum_steps=2)
+        step_fn = make_train_step(
+            built.model, built.tx, built.schedule,
+            pixel_stats=built.pixel_stats, plan=plan,
+            state_template=built.host,
+        )
+        bad = _batches(built.cfg, 2, 3)
+        with pytest.raises(ValueError, match="divisible"):
+            step_fn(jax.device_put(built.host), bad)
+
+
+class TestPlanResume:
+    @pytest.mark.slow  # executes full train steps (CI multichip smoke)
+    def test_bit_exact_resume_through_accum_step(self, built, tmp_path):
+        """PR-3's chaos guarantee on the plan path: save after an
+        accumulated step, restore into a fresh step-0 template, run one
+        more — bitwise identical to 2 uninterrupted steps.  (Momentum,
+        rng fold-in, and the restore round-trip are all in play; the
+        longer system-level property is tools/chaos.py's job.)"""
+        from mx_rcnn_tpu.train.checkpoint import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        step_fn = built.step_for(2)
+        batch = _batches(built.cfg, 2, 2)
+
+        state = jax.device_put(built.host)
+        for _ in range(2):
+            state, _ = step_fn(state, batch)
+        straight = jax.device_get(state)
+
+        state = jax.device_put(built.host)
+        state, _ = step_fn(state, batch)
+        ckpt_dir = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt_dir, jax.device_get(state), wait=True)
+        restored = restore_checkpoint(ckpt_dir, built.host)
+        assert int(restored.step) == 1
+        state = jax.device_put(restored)
+        state, _ = step_fn(state, batch)
+        resumed = jax.device_get(state)
+
+        _assert_trees_bitwise_equal(straight, resumed, "resume:")
+
+
+@pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device fake mesh"
+)
+class TestPlanOnMesh:
+    @pytest.fixture(scope="class")
+    def sharded(self, built):
+        mesh = make_mesh()
+        plan = ExecutionPlan.for_model(built.model, mesh=mesh, accum_steps=2)
+        step_fn = make_train_step(
+            built.model, built.tx, built.schedule,
+            pixel_stats=built.pixel_stats, plan=plan,
+            state_template=built.host,
+        )
+        return SimpleNamespace(mesh=mesh, plan=plan, step_fn=step_fn)
+
+    def test_state_shardings_follow_the_rules(self, built, sharded):
+        shardings = sharded.plan.state_shardings(built.host)
+        flat = jax.tree_util.tree_leaves(shardings)
+        assert len(flat) == len(jax.tree_util.tree_leaves(built.host))
+        assert all(s.spec == P() for s in flat)
+
+    def test_compiled_step_donates_every_state_buffer(self, built, sharded):
+        state = sharded.plan.shard_state(built.host)
+        batch = shard_batch(
+            _batches(built.cfg, 2, 8), sharded.mesh, stacked=True
+        )
+        txt = sharded.step_fn.lower(state, batch).as_text()
+        n_leaves = len(jax.tree_util.tree_leaves(built.host))
+        assert txt.count("tf.aliasing_output") >= n_leaves
+
+    @pytest.mark.slow  # executes full train steps (CI multichip smoke)
+    def test_sharded_accum_step_runs_and_updates(self, built, sharded):
+        state = sharded.plan.shard_state(built.host)
+        batch = shard_batch(
+            _batches(built.cfg, 2, 8), sharded.mesh, stacked=True
+        )
+        w_before = np.asarray(
+            jax.device_get(jax.tree_util.tree_leaves(built.host.params)[0])
+        )
+        state, metrics = sharded.step_fn(state, batch)
+        metrics = jax.device_get(metrics)
+        for k, v in metrics.items():
+            assert np.all(np.isfinite(v)), f"{k} not finite"
+        assert metrics["nonfinite"] == 0.0
+        assert int(state.step) == 1
+        w_after = np.asarray(
+            jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
+        )
+        assert not np.array_equal(w_before, w_after)
+
+
+class TestPrefetchStats:
+    def test_take_returns_and_resets(self):
+        st = PrefetchStats()
+        st.add(0.25)
+        st.add(0.05)
+        stall, n = st.take()
+        assert stall == pytest.approx(0.30)
+        assert n == 2
+        assert st.take() == (0.0, 0)
+
+    def test_synchronous_pulls_attribute_full_loader_time(self):
+        # host_depth=0: every next(it) runs in the consumer thread, so
+        # the whole per-batch loader time is stall by definition.
+        def slow():
+            for i in range(3):
+                time.sleep(0.02)
+                yield np.full(4, i, np.float32)
+
+        st = PrefetchStats()
+        out = list(
+            device_prefetch(slow(), mesh=None, depth=2, host_depth=0, stats=st)
+        )
+        assert [int(np.asarray(x)[0]) for x in out] == [0, 1, 2]
+        stall, n = st.take()
+        assert n == 3
+        assert stall >= 0.05
+
+    def test_buffered_batches_cost_exactly_zero(self):
+        # Deterministic fast-path check: wait until the background thread
+        # has the queue full, THEN consume — every pull hits get_nowait
+        # and records exactly 0.0 stall (buffered batches are free; the
+        # loader time they hid ran behind the device step).
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        items = [np.full(4, i, np.float32) for i in range(4)]
+        st = PrefetchStats()
+        p = _HostPrefetcher(iter(items), 4, stats=st)
+        deadline = time.monotonic() + 5.0
+        while p._q.qsize() < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert p._q.qsize() >= 4, "producer never filled the queue"
+        out = [int(next(p)[0]) for _ in range(4)]
+        assert out == [0, 1, 2, 3]
+        stall, n = st.take()
+        assert n == 4
+        assert stall == 0.0
+        p.close()

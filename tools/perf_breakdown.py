@@ -45,6 +45,10 @@ configure_cache(
         ".jax_cache",
     ),
     min_compile_secs=10,
+    # This tool migrates between driver hosts with the checkout; when the
+    # LLVM-feature probe is unavailable the plain cpuinfo key collided
+    # across them (MULTICHIP_r0* SIGILL tails) — separate hosts hard.
+    strict_host=True,
 )
 
 
