@@ -117,8 +117,19 @@ class ExecutionPlan:
     spatial: bool = False
     accum_steps: int = 1
     steps_per_call: int = 1
+    # Replica-per-chip serving (serve/fleet.py): pin this plan's programs
+    # to ONE device.  jit follows its committed operands, so placement
+    # happens through ``place`` (params land on the replica's chip) and
+    # the compiled programs execute there — no mesh, no resharding.
+    device: Optional[object] = None
 
     def __post_init__(self):
+        if self.device is not None and self.mesh is not None:
+            raise ValueError(
+                "device= pins a single-chip replica plan; a mesh plan "
+                "places state through its partition rules instead — "
+                "set one or the other"
+            )
         if self.accum_steps < 1 or self.steps_per_call < 1:
             raise ValueError(
                 f"accum_steps={self.accum_steps} / "
@@ -203,6 +214,20 @@ class ExecutionPlan:
             return jax.device_put(state)
         return jax.device_put(state, shardings)
 
+    def place(self, tree):
+        """Place an inference-shaped pytree (replicated params, quantized
+        trees) per the plan: onto ``device`` for a single-chip replica
+        plan, the default device otherwise.  Mesh plans place state
+        through :meth:`shard_state` (rule-matched layouts) instead."""
+        if self.mesh is not None:
+            raise ValueError(
+                "place() is the single-device path; a mesh plan places "
+                "state through shard_state() and its partition rules"
+            )
+        if self.device is None:
+            return jax.device_put(tree)
+        return jax.device_put(tree, self.device)
+
     def batch_specs(self) -> Batch:
         """Per-field PartitionSpec prefix tree for a train Batch."""
         lead = (None,) if self.stacked else ()
@@ -251,7 +276,9 @@ class ExecutionPlan:
         """Jit an inference-shaped ``fn(variables, batch)``: replicated
         params, data-sharded batch.  ``gather_outputs`` replicates the
         outputs (multi-host eval: a host can only device_get what it
-        addresses).  Off-mesh: plain jit — the serving engine's path."""
+        addresses).  Off-mesh: plain jit — the serving engine's path;
+        with ``device`` set, execution follows the ``place``-committed
+        params onto that one chip (replica-per-chip fleets)."""
         if self.mesh is None:
             return jax.jit(fn)
         rep = NamedSharding(self.mesh, P())

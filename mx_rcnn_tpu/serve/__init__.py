@@ -4,12 +4,15 @@
 compilation over fixed shape buckets, bounded-queue admission control,
 per-request deadlines, a degradation ladder, a circuit breaker, and a
 hang watchdog; ``EngineHealth`` exposes the readiness/liveness state
-machine and stats snapshot.
+machine and stats snapshot.  ``FleetRouter`` runs N replica engines as
+independent failure domains: least-loaded routing, hedged retries,
+quarantine/rebuild, zero-downtime weight swap, draining shutdown.
 """
 
 from mx_rcnn_tpu.serve.degrade import (
     LEVELS,
     CircuitBreaker,
+    HysteresisPlanner,
     LatencyEstimator,
     plan_level,
 )
@@ -24,11 +27,21 @@ from mx_rcnn_tpu.serve.engine import (
     ServeError,
     build_engine,
 )
+from mx_rcnn_tpu.serve.fleet import FleetRequest, FleetRouter, build_fleet
 from mx_rcnn_tpu.serve.health import EngineHealth
+from mx_rcnn_tpu.serve.router import (
+    DEAD,
+    DEGRADED,
+    QUARANTINED,
+    READY,
+    ReplicaView,
+    select_replica,
+)
 
 __all__ = [
     "LEVELS",
     "CircuitBreaker",
+    "HysteresisPlanner",
     "LatencyEstimator",
     "plan_level",
     "DeadlineExceeded",
@@ -40,5 +53,14 @@ __all__ = [
     "Plan",
     "ServeError",
     "build_engine",
+    "FleetRequest",
+    "FleetRouter",
+    "build_fleet",
     "EngineHealth",
+    "DEAD",
+    "DEGRADED",
+    "QUARANTINED",
+    "READY",
+    "ReplicaView",
+    "select_replica",
 ]

@@ -158,6 +158,80 @@ def plan_level(
     return candidates[-1]
 
 
+class HysteresisPlanner:
+    """Stateful :func:`plan_level` wrapper that damps upgrade thrash.
+
+    A replica sitting at the boundary between two levels (e.g. ``full``
+    vs ``full_q8`` when the full estimate hovers around the deadline)
+    would otherwise alternate program families request-by-request —
+    churning micro-batch grouping and making latency bimodal.  Policy:
+
+    * **Downgrades are immediate** — pressure is never absorbed.
+    * **Upgrades need margin and dwell** — moving to a better level
+      requires ``up_dwell`` consecutive plans where that level fits the
+      deadline with ``up_margin`` extra headroom (``estimate * headroom
+      * up_margin <= remaining``); a single borderline reading resets
+      the streak.  Requests without a deadline count toward the dwell
+      (no pressure signal), so a cleared incident still recovers.
+
+    Thread-safe; one instance per engine (the engine's worker is the
+    only planner, but ``stats`` readers may race it).
+    """
+
+    def __init__(
+        self,
+        headroom: float = 1.25,
+        up_margin: float = 1.5,
+        up_dwell: int = 3,
+    ) -> None:
+        if up_dwell < 1:
+            raise ValueError("up_dwell must be >= 1")
+        self.headroom = headroom
+        self.up_margin = up_margin
+        self.up_dwell = up_dwell
+        self._lock = threading.Lock()
+        self._level: Optional[str] = None
+        self._streak = 0
+
+    @property
+    def level(self) -> Optional[str]:
+        with self._lock:
+            return self._level
+
+    def plan(
+        self,
+        remaining: Optional[float],
+        estimates: Mapping[str, float],
+        full_allowed: bool,
+        available: Sequence[str],
+    ) -> str:
+        target = plan_level(
+            remaining, estimates, full_allowed, available,
+            headroom=self.headroom,
+        )
+        with self._lock:
+            current = self._level
+            if current is None or current not in available:
+                self._level, self._streak = target, 0
+                return target
+            if LEVELS.index(target) >= LEVELS.index(current):
+                # Same or worse quality: follow plan_level immediately.
+                self._level, self._streak = target, 0
+                return target
+            # Upgrade candidate: count margin-clean plans before moving.
+            est = estimates.get(target)
+            comfortable = (
+                remaining is None
+                or est is None
+                or est * self.headroom * self.up_margin <= remaining
+            )
+            self._streak = self._streak + 1 if comfortable else 0
+            if self._streak >= self.up_dwell:
+                self._level, self._streak = target, 0
+                return target
+            return current
+
+
 class LatencyEstimator:
     """Per-level EWMA of observed serving latency (seconds)."""
 

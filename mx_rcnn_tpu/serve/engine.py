@@ -44,8 +44,8 @@ from mx_rcnn_tpu.serve import health as health_mod
 from mx_rcnn_tpu.serve.degrade import (
     FULL_QUALITY_LEVELS,
     CircuitBreaker,
+    HysteresisPlanner,
     LatencyEstimator,
-    plan_level,
 )
 
 log = logging.getLogger("mx_rcnn_tpu.serve")
@@ -77,7 +77,7 @@ class InferenceRequest:
     """A submitted request; ``result()`` blocks until served or failed."""
 
     __slots__ = ("image", "enqueued_at", "deadline", "_event", "_result",
-                 "_error", "plan")
+                 "_error", "plan", "_callbacks", "_cb_lock")
 
     def __init__(self, image: np.ndarray, enqueued_at: float,
                  deadline: Optional[float]) -> None:
@@ -88,17 +88,49 @@ class InferenceRequest:
         self._result: Optional[dict] = None
         self._error: Optional[BaseException] = None
         self.plan: Optional[Plan] = None
+        self._callbacks: list[Callable[["InferenceRequest"], None]] = []
+        self._cb_lock = threading.Lock()
 
     def _set_result(self, result: dict) -> None:
         self._result = result
-        self._event.set()
+        self._finish()
 
     def _set_error(self, error: BaseException) -> None:
         self._error = error
+        self._finish()
+
+    def _finish(self) -> None:
         self._event.set()
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 - a callback must not kill
+                log.exception("request done-callback raised")  # the worker
+
+    def add_done_callback(
+        self, fn: Callable[["InferenceRequest"], None]
+    ) -> None:
+        """Call ``fn(request)`` exactly once when the request completes
+        (success or failure); immediately if it already did.  The fleet
+        router uses this to wake hedging watchers without polling."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def error(self) -> Optional[BaseException]:
+        """The failure, if the request is done and failed (non-blocking)."""
+        return self._error if self._event.is_set() else None
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until done (or ``timeout``); True when complete."""
+        return self._event.wait(timeout)
 
     def result(self, timeout: Optional[float] = None) -> dict:
         """The served detections dict (boxes/scores/classes/level/...);
@@ -128,6 +160,18 @@ class DetectorRunner:
     boxes back to original image coordinates.  Any (mode, bucket) pair
     outside the warmed set is a hard error — the no-recompile guarantee
     is enforced here rather than discovered in a latency graph.
+
+    **Double-buffered weights**: the live params (and quantized head)
+    ride one ``_active`` tuple; :meth:`swap_weights` transfers the new
+    tree to the device and blocks until it is resident *while the live
+    buffer keeps serving*, then flips the tuple — a single reference
+    assignment, so a concurrent ``run`` sees entirely-old or
+    entirely-new weights, never a mix.  Every result carries the
+    ``generation`` that served it.
+
+    ``device`` pins the runner to one chip (replica-per-chip fleets,
+    serve/fleet.py): params commit there via the execution plan's
+    ``place`` and the jitted programs follow them.
     """
 
     def __init__(
@@ -139,6 +183,7 @@ class DetectorRunner:
         reduced_max_detections: Optional[int] = None,
         with_proposals: bool = True,
         int8_head: bool = False,
+        device: Optional[object] = None,
     ) -> None:
         import dataclasses
 
@@ -172,7 +217,6 @@ class DetectorRunner:
             ),
         )
         reduced_model = TwoStageDetector(cfg=reduced_cfg)
-        self._variables = jax.device_put(variables)
 
         from mx_rcnn_tpu.detection.graph import (
             forward_inference,
@@ -183,11 +227,14 @@ class DetectorRunner:
         # programs of the same callable (different static shapes).  All
         # compile through the execution plan (parallel/plan.py) — the
         # same scaffolding the train/eval steps use; serving runs the
-        # plan's mesh-less form (plain jit) today, and a sharded server
-        # is one ``mesh=`` away rather than a rewrite.
+        # plan's mesh-less form (plain jit, optionally pinned to one
+        # replica chip), and a sharded server is one ``mesh=`` away
+        # rather than a rewrite.
         from mx_rcnn_tpu.parallel.plan import ExecutionPlan
 
-        plan = ExecutionPlan(mesh=None)
+        plan = ExecutionPlan(mesh=None, device=device)
+        self._plan = plan
+        self.device = device
         self._steps = {
             "full": plan.compile_infer(
                 lambda v, b: forward_inference(model, v, b, pixel_stats=stats)
@@ -202,18 +249,17 @@ class DetectorRunner:
             ),
         }
         self._program_keys = [("full", b) for b in self.buckets]
+        self._int8_head = bool(int8_head)
         if int8_head:
-            from mx_rcnn_tpu.serve.quantize import (
-                apply_box_head_q8,
-                quantize_box_head,
-            )
+            from mx_rcnn_tpu.serve.quantize import apply_box_head_q8
 
             # The quantized tree rides as a jit ARGUMENT (device buffers),
-            # not a closure — same request-size reasoning as _variables.
-            self._box_q8 = jax.device_put(quantize_box_head(variables))
-            # Mesh-less plan compile == plain jit, so the extra quantized
-            # operand is fine; a sharded plan would need its own spec.
-            q8_step = plan.compile_infer(
+            # not a closure — same request-size reasoning as the params,
+            # and swap_weights can re-quantize and flip it atomically
+            # alongside them.  Mesh-less plan compile == plain jit, so
+            # the extra operand is fine; a sharded plan would need its
+            # own spec.
+            self._q8_step = plan.compile_infer(
                 lambda v, q, b: forward_inference(
                     model, v, b, pixel_stats=stats,
                     box_head_apply=lambda pooled: apply_box_head_q8(
@@ -221,12 +267,14 @@ class DetectorRunner:
                     ),
                 )
             )
-            self._steps["full_q8"] = (
-                lambda v, b: q8_step(v, self._box_q8, b)
-            )
             # Like the other degrade programs, compiled for the smallest
             # bucket only (engine._plan routes non-full levels there).
             self._program_keys.append(("full_q8", self.buckets[0]))
+        # Live weight buffers: (params, quantized head | None, generation).
+        # One tuple so the swap flip is a single reference assignment.
+        self._active = (
+            plan.place(variables), self._quantized(variables), 0
+        )
         if with_proposals:
             self._program_keys += [
                 ("reduced", self.buckets[0]),
@@ -235,6 +283,74 @@ class DetectorRunner:
         else:
             self._program_keys += [("reduced", self.buckets[0])]
         self._warmed: set[tuple[str, tuple[int, int]]] = set()
+
+    # -- weights ----------------------------------------------------------
+
+    def _quantized(self, variables):
+        """Quantize + place the box head for the q8 program (or None)."""
+        if not self._int8_head:
+            return None
+        from mx_rcnn_tpu.serve.quantize import quantize_box_head
+
+        return self._plan.place(quantize_box_head(variables))
+
+    @property
+    def generation(self) -> int:
+        """Monotonic weight-swap counter; 0 = the construction weights."""
+        return self._active[2]
+
+    def swap_weights(self, variables, generation: Optional[int] = None) -> int:
+        """Zero-downtime weight swap: warm the standby buffer, then flip.
+
+        The new tree (and re-quantized int8 head, when enabled) is
+        transferred to the replica device and blocked-until-resident
+        while the live buffer keeps serving; the flip is one tuple
+        assignment, so no request ever executes against a half-swapped
+        tree.  The compiled programs are untouched — identical
+        shapes/dtypes are enforced below, so the swap can never trigger
+        a recompile on the serving path.  Returns the new generation
+        (``generation`` overrides the default +1 — the fleet uses it to
+        align a rebuilt replica with the fleet generation).
+        """
+        import jax
+
+        live_vars, _, live_gen = self._active
+        flat_new = jax.tree_util.tree_flatten(variables)
+        flat_live = jax.tree_util.tree_flatten(live_vars)
+        if flat_new[1] != flat_live[1]:
+            raise ValueError(
+                "swap_weights: new tree structure differs from the live "
+                "tree — a swap must not change the compiled programs"
+            )
+        def sig(x):  # no np.asarray: must not device_get the live tree
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return (tuple(x.shape), str(x.dtype))
+            arr = np.asarray(x)
+            return (arr.shape, str(arr.dtype))
+
+        for new, old in zip(flat_new[0], flat_live[0]):
+            if sig(new) != sig(old):
+                raise ValueError(
+                    f"swap_weights: leaf shape/dtype drift "
+                    f"{sig(old)} -> {sig(new)} — a swap must not change "
+                    "the compiled programs"
+                )
+        new_vars = self._plan.place(variables)
+        new_q8 = self._quantized(variables)
+        # Warm the standby buffer: the transfer completes (device-resident
+        # HBM) before the flip, so the first post-flip request pays zero
+        # copy latency.
+        jax.block_until_ready(
+            new_vars if new_q8 is None else (new_vars, new_q8)
+        )
+        gen = live_gen + 1 if generation is None else int(generation)
+        if gen <= live_gen:
+            raise ValueError(
+                f"swap_weights: generation must be monotonic "
+                f"({live_gen} -> {gen})"
+            )
+        self._active = (new_vars, new_q8, gen)
+        return gen
 
     # -- engine-facing surface --------------------------------------------
 
@@ -265,6 +381,9 @@ class DetectorRunner:
 
     def warmup(self) -> int:
         """Compile every program with a zero batch; returns program count."""
+        import jax
+
+        variables, box_q8, _ = self._active
         for mode, bucket in self._program_keys:
             batch = self._make_batch(
                 np.zeros((self.batch_size, *bucket, 3), np.float32),
@@ -272,9 +391,10 @@ class DetectorRunner:
                     np.asarray([bucket], np.float32), (self.batch_size, 1)
                 ),
             )
-            out = self._steps[mode](self._variables, batch)
-            import jax
-
+            if mode == "full_q8":
+                out = self._q8_step(variables, box_q8, batch)
+            else:
+                out = self._steps[mode](variables, batch)
             jax.block_until_ready(out)
             self._warmed.add((mode, bucket))
         return len(self._warmed)
@@ -295,6 +415,10 @@ class DetectorRunner:
 
         from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
 
+        # One read of the live buffers: the whole micro-batch executes
+        # against a consistent (params, q8, generation) snapshot even if
+        # swap_weights flips mid-call.
+        variables, box_q8, generation = self._active
         rows, hw, scales, orig = [], [], [], []
         for img in images:
             h, w = img.shape[:2]
@@ -320,11 +444,17 @@ class DetectorRunner:
         batch = self._make_batch(
             np.stack(rows), np.asarray(hw, np.float32)
         )
-        out = jax.device_get(self._steps[mode](self._variables, batch))
-        return [
+        if mode == "full_q8":
+            out = jax.device_get(self._q8_step(variables, box_q8, batch))
+        else:
+            out = jax.device_get(self._steps[mode](variables, batch))
+        results = [
             self._postprocess(mode, out, i, scales[i], *orig[i])
             for i in range(len(images))
         ]
+        for res in results:
+            res["generation"] = generation
+        return results
 
     # -- internals ---------------------------------------------------------
 
@@ -380,7 +510,10 @@ class InferenceEngine:
         hang_timeout: float = 60.0,
         watchdog_poll: float = 0.25,
         headroom: float = 1.25,
+        up_margin: float = 1.5,
+        up_dwell: int = 3,
         breaker: Optional[CircuitBreaker] = None,
+        replica_id: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.runner = runner
@@ -391,15 +524,22 @@ class InferenceEngine:
         self.headroom = headroom
         self.breaker = breaker or CircuitBreaker(clock=clock)
         self.estimates = LatencyEstimator()
-        self.health = health_mod.EngineHealth(clock=clock)
+        self.planner = HysteresisPlanner(
+            headroom=headroom, up_margin=up_margin, up_dwell=up_dwell
+        )
+        self.replica_id = replica_id
+        self.health = health_mod.EngineHealth(
+            clock=clock, replica_id=replica_id
+        )
         self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=max_queue)
-        self._carry: Optional[InferenceRequest] = None
+        self._carry = None  # InferenceRequest | _STOP carried across takes
         self._inflight_since: Optional[float] = None
         self._inflight_plan: Optional[Plan] = None
         self._inflight_reqs: list[InferenceRequest] = []
         self._lock = threading.Lock()
         self._started = False
-        self._stopping = False
+        self._draining = False  # no new admissions; accepted work flushes
+        self._stopping = False  # the worker must exit
         self._worker: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
 
@@ -431,20 +571,57 @@ class InferenceEngine:
         self._watchdog.start()
         return self
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def stop(self, timeout: float = 10.0, drain: bool = True) -> None:
+        """Shut down.  With ``drain`` (the default) admission stops
+        FIRST, the worker flushes every already-accepted request, and
+        only then is any residue failed — an accepted request is a
+        promise, and a routine stop must not break it.  ``drain=False``
+        is the fast path: queued requests fail immediately with
+        ``EngineUnavailable("engine stopping")`` (typed as a shutdown,
+        not a serving failure, so fleet retry logic can tell them
+        apart)."""
         if not self._started or self._stopping:
             return
-        self._stopping = True
+        self._draining = True  # submit() refuses from here on
+        if not drain:
+            self._stopping = True
         try:
-            self._queue.put_nowait(self._STOP)
+            # Blocking put: FIFO places the sentinel BEHIND every
+            # accepted request, so a draining worker flushes them all
+            # before it sees the stop.
+            self._queue.put(self._STOP, timeout=timeout)
         except queue_mod.Full:
             pass
         if self._worker is not None:
             self._worker.join(timeout)
-        self._fail_pending(EngineUnavailable("engine stopped"))
+        self._stopping = True
+        self._fail_pending(EngineUnavailable("engine stopping"))
         self.health.transition(health_mod.DEAD, "stopped")
         if self._watchdog is not None:
             self._watchdog.join(timeout)
+
+    def kill(self, reason: str = "killed") -> None:
+        """Hard-fail the engine: DEAD now, every in-flight and queued
+        request fails with a typed error.  The fleet router uses this to
+        fence a quarantined replica (waiters fail fast and retry on a
+        healthy one); chaos scenarios use it as the crash injection."""
+        self.health.transition(health_mod.DEAD, reason)
+        error = EngineUnavailable(f"engine died: {reason}")
+        with self._lock:
+            stuck = list(self._inflight_reqs)
+        for r in stuck:
+            r._set_error(error)
+        self._fail_pending(error)
+
+    def swap_weights(
+        self, variables, generation: Optional[int] = None
+    ) -> int:
+        """Zero-downtime weight swap, delegated to the runner (standby
+        warm + atomic flip) and recorded in the health snapshot.  Safe
+        under live traffic."""
+        gen = self.runner.swap_weights(variables, generation=generation)
+        self.health.record_swap(gen)
+        return gen
 
     def __enter__(self) -> "InferenceEngine":
         return self.start()
@@ -460,8 +637,10 @@ class InferenceEngine:
         """Enqueue one image; returns immediately.  Raises
         :class:`Overloaded` when the queue is full, or
         :class:`EngineUnavailable` when the engine cannot serve."""
-        if not self._started or self._stopping:
+        if not self._started:
             raise EngineUnavailable("engine not started")
+        if self._draining or self._stopping:
+            raise EngineUnavailable("engine stopping")
         if not self.health.alive():
             raise EngineUnavailable(
                 f"engine is dead: {self.health.reason}"
@@ -486,6 +665,11 @@ class InferenceEngine:
     ) -> dict:
         return self.submit(image, timeout).result()
 
+    @property
+    def queue_depth(self) -> int:
+        """Accepted-but-unserved request count (router load signal)."""
+        return self._queue.qsize()
+
     def stats(self) -> dict:
         with self._lock:
             inflight_age = (
@@ -496,6 +680,7 @@ class InferenceEngine:
         return self.health.snapshot(
             queue_depth=self._queue.qsize(),
             inflight_age_s=inflight_age,
+            draining=self._draining,
             breaker=self.breaker.state,
             breaker_trips=self.breaker.trips,
             latency_estimates_s=self.estimates.snapshot(),
@@ -516,9 +701,8 @@ class InferenceEngine:
             None if req.deadline is None else req.deadline - self._clock()
         )
         full_ok = self.breaker.allow_full()
-        level = plan_level(
-            remaining, self.estimates.snapshot(), full_ok, available,
-            headroom=self.headroom,
+        level = self.planner.plan(
+            remaining, self.estimates.snapshot(), full_ok, available
         )
         if full_ok and level not in FULL_QUALITY_LEVELS:
             # Consumed a half-open probe but was forced to degrade anyway
@@ -543,6 +727,8 @@ class InferenceEngine:
         available requests with the SAME plan, up to the static batch."""
         while True:
             if self._carry is not None:
+                if self._carry is self._STOP:
+                    return []
                 first, self._carry = self._carry, None
             else:
                 try:
@@ -569,7 +755,10 @@ class InferenceEngine:
                 except queue_mod.Empty:
                     break
                 if nxt is self._STOP:
-                    self._stopping = True
+                    # The carry slot is free here (a set carry breaks the
+                    # loop above), so park the sentinel: this batch still
+                    # runs, the NEXT take returns the stop.
+                    self._carry = self._STOP
                     break
                 if (
                     nxt.deadline is not None
@@ -658,6 +847,11 @@ class InferenceEngine:
                     res = dict(res)
                     res["level"] = plan.level
                     res["latency_s"] = latency
+                    # Fake runners in tests may not tag provenance.
+                    res.setdefault(
+                        "generation",
+                        getattr(self.runner, "generation", 0),
+                    )
                     r._set_result(res)
             if (
                 self.health.state == health_mod.DEGRADED
@@ -671,7 +865,8 @@ class InferenceEngine:
 
     def _fail_pending(self, error: BaseException) -> None:
         if self._carry is not None:
-            self._carry._set_error(error)
+            if self._carry is not self._STOP:
+                self._carry._set_error(error)
             self._carry = None
         while True:
             try:
@@ -718,12 +913,13 @@ def build_engine(
     buckets: Optional[Sequence[tuple[int, int]]] = None,
     batch_size: int = 1,
     int8_head: bool = False,
+    device: Optional[object] = None,
     **engine_kwargs,
 ) -> InferenceEngine:
     """Convenience: real runner + engine from a config and variables
     (checkpoint-restored or freshly initialized)."""
     runner = DetectorRunner(
         cfg, variables, buckets=buckets, batch_size=batch_size,
-        int8_head=int8_head,
+        int8_head=int8_head, device=device,
     )
     return InferenceEngine(runner, **engine_kwargs)

@@ -1,0 +1,725 @@
+"""Fleet serving: N replica engines behind one fault-isolating router.
+
+One :class:`~mx_rcnn_tpu.serve.engine.InferenceEngine` is one failure
+domain: a wedged device call or a bad weight push takes down everything
+behind it.  :class:`FleetRouter` runs N of them — replica-per-chip via
+the execution plan's ``device=`` pinning — and treats each as
+disposable:
+
+* **Routing** — bucket-aware least-loaded dispatch (serve/router.py);
+  every replica keeps its own circuit breaker and degrade ladder, so one
+  replica under pressure degrades alone instead of dragging the fleet.
+* **Hedged retry** — a request that lingers past ``hedge_after`` gets a
+  duplicate on a second replica; the first result wins (idempotent
+  latch), the loser is dropped.  Failed attempts retry on fresh
+  replicas up to ``max_attempts``.
+* **Quarantine → rebuild → reinstate** — a replica whose engine dies
+  (watchdog, crash injection) or fails repeatedly is fenced
+  (``engine.kill`` fails its queue fast so waiters retry elsewhere),
+  rebuilt in the background from the engine factory, re-warmed, swapped
+  to the fleet's current weight generation, and put back in rotation.
+  ``max_rebuilds`` failures retire it to DEAD.
+* **Zero-downtime weight swap** — ``swap_weights`` rolls the fleet one
+  replica at a time; each replica warms the new tree on a standby
+  buffer while its live buffer serves, then flips atomically
+  (serve/engine.py::DetectorRunner.swap_weights).  No request ever
+  executes against a half-swapped tree, and every response carries the
+  ``generation`` that served it.
+* **Draining shutdown** — ``drain()`` stops admitting, flushes every
+  accepted request, then stops the replicas; ``serve_forever``-style
+  callers pair it with SIGTERM → exit
+  ``train/preemption.py::RESUMABLE_EXIT_CODE`` (75), the same
+  convention the trainer uses for preemption.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional, Sequence, Union
+
+from mx_rcnn_tpu.serve.engine import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    InferenceEngine,
+    Overloaded,
+    ServeError,
+)
+from mx_rcnn_tpu.serve.router import (
+    DEAD,
+    DEGRADED,
+    QUARANTINED,
+    READY,
+    ROUTABLE,
+    ReplicaView,
+    auto_hedge_delay,
+    select_replica,
+)
+
+log = logging.getLogger("mx_rcnn_tpu.serve")
+
+
+class FleetRequest:
+    """A fleet-level request: one logical answer over possibly several
+    replica attempts (retries, hedges).  First completion wins; the
+    latch is idempotent, so a late duplicate result is dropped, never
+    double-delivered."""
+
+    def __init__(self, image, enqueued_at: float,
+                 deadline: Optional[float]) -> None:
+        self.image = image
+        self.enqueued_at = enqueued_at
+        self.deadline = deadline
+        self.bucket: Optional[tuple[int, int]] = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+        self._wake = threading.Event()  # watcher wakes on sub completion
+        self._attempts: list[_Attempt] = []
+        # Watcher-thread-private bookkeeping (single writer):
+        self._retries = 0
+        self._hedged = False
+
+    def _latch_result(self, result: dict) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._event.set()
+            return True
+
+    def _latch_error(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._error = error
+            self._event.set()
+            return True
+
+    def tried_rids(self) -> frozenset[int]:
+        with self._lock:
+            return frozenset(a.rid for a in self._attempts)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self._event.wait(timeout):
+            raise TimeoutError("fleet request not complete")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class _Attempt:
+    """One replica submission of a fleet request."""
+
+    __slots__ = ("rid", "sub", "is_hedge", "handled")
+
+    def __init__(self, rid: int, sub, is_hedge: bool) -> None:
+        self.rid = rid
+        self.sub = sub
+        self.is_hedge = is_hedge
+        self.handled = False  # watcher-private: failure already processed
+
+
+class _Replica:
+    """Mutable fleet-side record for one replica slot."""
+
+    __slots__ = ("rid", "engine", "state", "inflight", "fail_streak",
+                 "rebuilds", "rebuilding", "rebuild_thread")
+
+    def __init__(self, rid: int) -> None:
+        self.rid = rid
+        self.engine: Optional[InferenceEngine] = None
+        self.state = QUARANTINED  # not routable until start() warms it
+        self.inflight = 0
+        self.fail_streak = 0
+        self.rebuilds = 0
+        self.rebuilding = False
+        self.rebuild_thread: Optional[threading.Thread] = None
+
+
+class FleetRouter:
+    """Router + supervisor over N replica engines.
+
+    ``engine_factory(rid)`` builds a started-able engine for replica
+    slot ``rid`` (see :func:`build_fleet` for the real JAX wiring); the
+    supervisor reuses it for background rebuilds, so a factory must be
+    safe to call at any time.
+
+    ``hedge_after`` — seconds before a still-pending request gets a
+    duplicate on a second replica: a float, ``"auto"`` (3x the observed
+    full-path latency, serve/router.py::auto_hedge_delay), or None to
+    disable hedging.
+    """
+
+    def __init__(
+        self,
+        engine_factory: Callable[[int], InferenceEngine],
+        n_replicas: int,
+        *,
+        hedge_after: Union[float, str, None] = None,
+        max_attempts: int = 2,
+        quarantine_failures: int = 3,
+        max_rebuilds: int = 3,
+        supervisor_poll: float = 0.25,
+        default_timeout: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self._engine_factory = engine_factory
+        self.n_replicas = n_replicas
+        self.hedge_after = hedge_after
+        self.max_attempts = max_attempts
+        self.quarantine_failures = quarantine_failures
+        self.max_rebuilds = max_rebuilds
+        self.supervisor_poll = supervisor_poll
+        self.default_timeout = default_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()
+        self._replicas = [_Replica(rid) for rid in range(n_replicas)]
+        self._weights = None       # last swapped tree (rebuild alignment)
+        self._generation = 0
+        self._pending = 0
+        self._started = False
+        self._draining = False
+        self._stopped = False
+        self._stop_event = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        # Fleet counters (under _lock).
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._shed = 0
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._retries_total = 0
+        self._quarantines = 0
+        self._reinstatements = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        if self._started:
+            return self
+        for r in self._replicas:
+            r.engine = self._engine_factory(r.rid)
+            r.engine.start()
+            r.state = READY
+        self._started = True
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="fleet-supervisor", daemon=True
+        )
+        self._supervisor.start()
+        log.info("fleet ready: %d replicas", self.n_replicas)
+        return self
+
+    def stop(self, timeout: float = 10.0, drain: bool = True) -> None:
+        if self._stopped:
+            return
+        self._draining = True
+        self._stopped = True
+        self._stop_event.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout)
+        for r in self._replicas:
+            if r.engine is None:
+                continue
+            try:
+                r.engine.stop(timeout=timeout, drain=drain)
+            except Exception:
+                log.exception("stopping replica %d failed", r.rid)
+        # A rebuild caught mid-compile cannot be interrupted; wait it
+        # out rather than exit the interpreter under a live XLA thread
+        # (which aborts the process instead of raising).
+        for r in self._replicas:
+            t = r.rebuild_thread
+            if t is not None and t.is_alive():
+                t.join(timeout)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Draining shutdown: stop admitting, wait for every accepted
+        fleet request to complete, then stop the replicas (which flush
+        their own queues).  Returns True when nothing was abandoned —
+        the SIGTERM handler pairs this with exit code 75
+        (train/preemption.py) so a supervisor restarts the process."""
+        self._draining = True
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            clean = self._pending == 0
+        self.stop(timeout=max(1.0, deadline - self._clock()), drain=True)
+        return clean
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client API --------------------------------------------------------
+
+    def submit(self, image, timeout: Optional[float] = None) -> FleetRequest:
+        """Route one image; returns immediately.  Raises
+        :class:`Overloaded` when every routable replica shed it, or
+        :class:`EngineUnavailable` when no replica can serve."""
+        if not self._started:
+            raise EngineUnavailable("fleet not started")
+        if self._draining or self._stopped:
+            raise EngineUnavailable("fleet stopping")
+        now = self._clock()
+        timeout = self.default_timeout if timeout is None else timeout
+        freq = FleetRequest(
+            image, now, None if timeout is None else now + timeout
+        )
+        freq.bucket = self._bucket_for(image)
+        try:
+            self._place(freq, is_hedge=False)
+        except Overloaded:
+            with self._lock:
+                self._submitted += 1
+                self._shed += 1
+            raise
+        except ServeError:
+            with self._lock:
+                self._submitted += 1
+                self._failed += 1
+            raise
+        with self._lock:
+            self._submitted += 1
+            self._pending += 1
+        threading.Thread(
+            target=self._watch, args=(freq,),
+            name="fleet-watch", daemon=True,
+        ).start()
+        return freq
+
+    def infer(self, image, timeout: Optional[float] = None) -> dict:
+        return self.submit(image, timeout).result()
+
+    def swap_weights(self, variables) -> int:
+        """Zero-downtime fleet weight swap: bump the fleet generation,
+        then roll the live replicas ONE AT A TIME — each warms the new
+        tree on its standby buffer while serving, then flips atomically.
+        A replica that fails its swap is quarantined (the supervisor
+        rebuilds it straight onto the new generation) and the roll
+        continues.  Returns the new generation."""
+        with self._swap_lock:
+            with self._lock:
+                target = self._generation + 1
+                self._weights = variables
+                self._generation = target
+                live = [
+                    r for r in self._replicas if r.state in ROUTABLE
+                ]
+            for r in live:
+                try:
+                    r.engine.swap_weights(variables, generation=target)
+                except Exception as e:  # noqa: BLE001 - fault-isolate
+                    log.exception(
+                        "fleet: weight swap failed on replica %d", r.rid
+                    )
+                    self._quarantine(r, f"swap failed: {e}")
+            return target
+
+    def kill_replica(self, rid: int, reason: str = "operator kill") -> None:
+        """Chaos/ops hook: hard-kill one replica.  Its accepted work
+        fails over through retry; the supervisor rebuilds it."""
+        self._quarantine(self._replicas[rid], reason)
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "replicas": self.n_replicas,
+                "generation": self._generation,
+                "pending": self._pending,
+                "draining": self._draining,
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": self._shed,
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "retries": self._retries_total,
+                "quarantines": self._quarantines,
+                "reinstatements": self._reinstatements,
+            }
+            reps = [
+                (r.rid, r.state, r.inflight, r.fail_streak, r.rebuilds,
+                 r.engine)
+                for r in self._replicas
+            ]
+        out["replica"] = [
+            {
+                "rid": rid,
+                "state": state,
+                "inflight": inflight,
+                "fail_streak": streak,
+                "rebuilds": rebuilds,
+                "engine": None if eng is None else eng.stats(),
+            }
+            for rid, state, inflight, streak, rebuilds, eng in reps
+        ]
+        return out
+
+    # -- placement ---------------------------------------------------------
+
+    def _bucket_for(self, image) -> Optional[tuple[int, int]]:
+        shape = getattr(image, "shape", None)
+        if not shape or len(shape) < 2:
+            return None
+        for r in self._replicas:
+            if r.state in ROUTABLE and r.engine is not None:
+                try:
+                    return tuple(
+                        r.engine.runner.pick_bucket(shape[0], shape[1])
+                    )
+                except Exception:  # noqa: BLE001 - routing hint only
+                    return None
+        return None
+
+    def _views(self) -> list[ReplicaView]:
+        with self._lock:
+            reps = [
+                (r.rid, r.state, r.inflight, r.engine)
+                for r in self._replicas
+            ]
+        views = []
+        for rid, state, inflight, eng in reps:
+            if eng is None:
+                continue
+            if state in ROUTABLE and eng.health.state == "degraded":
+                state = DEGRADED
+            views.append(ReplicaView(
+                rid=rid,
+                state=state,
+                inflight=inflight,
+                queue_depth=eng.queue_depth,
+                buckets=tuple(
+                    tuple(b) for b in getattr(eng.runner, "buckets", ())
+                ),
+                generation=getattr(eng.health, "generation", 0),
+            ))
+        return views
+
+    def _place(self, freq: FleetRequest, is_hedge: bool) -> _Attempt:
+        """Submit ``freq`` to the best fresh replica.  Raises
+        :class:`Overloaded` when every candidate shed it,
+        :class:`EngineUnavailable` when none is routable, or
+        :class:`DeadlineExceeded` when the budget is already gone."""
+        exclude = set(freq.tried_rids())
+        overloaded = False
+        while True:
+            view = select_replica(
+                self._views(), bucket=freq.bucket,
+                exclude=frozenset(exclude),
+            )
+            if view is None:
+                if overloaded:
+                    raise Overloaded(
+                        "every routable replica shed the request"
+                    )
+                raise EngineUnavailable("no routable replica")
+            r = self._replicas[view.rid]
+            remaining = (
+                None if freq.deadline is None
+                else freq.deadline - self._clock()
+            )
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceeded("deadline passed before placement")
+            eng = r.engine
+            if eng is None:
+                exclude.add(view.rid)
+                continue
+            try:
+                sub = eng.submit(freq.image, timeout=remaining)
+            except Overloaded:
+                overloaded = True
+                exclude.add(view.rid)
+                continue
+            except EngineUnavailable:
+                # Raced the replica dying; the supervisor will fence it.
+                exclude.add(view.rid)
+                continue
+            att = _Attempt(view.rid, sub, is_hedge)
+            with self._lock:
+                r.inflight += 1
+                if is_hedge:
+                    self._hedges += 1
+            with freq._lock:
+                freq._attempts.append(att)
+            sub.add_done_callback(
+                lambda _s, r=r, freq=freq, att=att:
+                self._on_sub_done(r, freq, att)
+            )
+            return att
+
+    def _on_sub_done(self, r: _Replica, freq: FleetRequest,
+                     att: _Attempt) -> None:
+        with self._lock:
+            r.inflight = max(0, r.inflight - 1)
+        err = att.sub.error()
+        self._observe(r, err)
+        if err is None:
+            try:
+                res = att.sub.result(timeout=0)
+            except Exception:  # noqa: BLE001 - raced a failure
+                res = None
+            if res is not None:
+                res = dict(res)
+                res["replica_id"] = r.rid
+                if freq._latch_result(res):
+                    with self._lock:
+                        self._completed += 1
+                        if att.is_hedge:
+                            self._hedge_wins += 1
+        freq._wake.set()
+
+    # -- per-request watcher ----------------------------------------------
+
+    def _hedge_delay(self) -> Optional[float]:
+        if self.hedge_after is None:
+            return None
+        if self.hedge_after == "auto":
+            for r in self._replicas:
+                if r.state in ROUTABLE and r.engine is not None:
+                    return auto_hedge_delay(r.engine.estimates.snapshot())
+            return None
+        return float(self.hedge_after)
+
+    def _watch(self, freq: FleetRequest) -> None:
+        """One thread per fleet request: latches the deadline, retries
+        failed attempts on fresh replicas, and launches the hedge.
+        Woken by sub done-callbacks instead of polling."""
+        try:
+            while True:
+                if freq.done():
+                    return
+                now = self._clock()
+                if freq.deadline is not None and now >= freq.deadline:
+                    if freq._latch_error(
+                        DeadlineExceeded("fleet deadline exceeded")
+                    ):
+                        with self._lock:
+                            self._failed += 1
+                    return
+                waits = [self.supervisor_poll]
+                if freq.deadline is not None:
+                    waits.append(freq.deadline - now)
+                hedge_at = None
+                if not freq._hedged:
+                    delay = self._hedge_delay()
+                    if delay is not None:
+                        hedge_at = freq.enqueued_at + delay
+                        waits.append(hedge_at - now)
+                freq._wake.wait(max(0.005, min(waits)))
+                freq._wake.clear()
+                if freq.done():
+                    return
+                now = self._clock()
+                with freq._lock:
+                    attempts = list(freq._attempts)
+                live = sum(1 for a in attempts if not a.sub.done())
+                last_err: Optional[BaseException] = None
+                for a in attempts:
+                    if a.handled or not a.sub.done():
+                        continue
+                    err = a.sub.error()
+                    if err is None:
+                        continue  # success; the callback latched it
+                    a.handled = True
+                    last_err = err
+                    if isinstance(err, DeadlineExceeded):
+                        continue  # retrying cannot beat a global deadline
+                    if freq._retries < self.max_attempts - 1:
+                        freq._retries += 1
+                        with self._lock:
+                            self._retries_total += 1
+                        try:
+                            self._place(freq, is_hedge=False)
+                            live += 1
+                        except ServeError as e:
+                            last_err = e
+                if live == 0:
+                    if freq._latch_error(
+                        last_err
+                        or EngineUnavailable("no replica could serve")
+                    ):
+                        with self._lock:
+                            self._failed += 1
+                    return
+                if (
+                    hedge_at is not None
+                    and now >= hedge_at
+                    and not freq._hedged
+                ):
+                    try:
+                        self._place(freq, is_hedge=True)
+                        freq._hedged = True
+                    except ServeError:
+                        pass  # no fresh replica yet; try on the next wake
+        finally:
+            with self._lock:
+                self._pending -= 1
+
+    # -- supervision -------------------------------------------------------
+
+    def _observe(self, r: _Replica, err: Optional[BaseException]) -> None:
+        """Per-attempt health accounting.  Deadline misses and sheds are
+        load signals, not replica faults; a typed engine death fences
+        immediately; repeated serving failures fence after a streak."""
+        if self._draining or self._stopped:
+            return
+        if err is None:
+            with self._lock:
+                r.fail_streak = 0
+            return
+        if isinstance(err, (DeadlineExceeded, Overloaded)):
+            return
+        if isinstance(err, EngineUnavailable):
+            self._quarantine(r, f"engine unavailable: {err}")
+            return
+        with self._lock:
+            r.fail_streak += 1
+            streak = r.fail_streak
+        if streak >= self.quarantine_failures:
+            self._quarantine(r, f"{streak} consecutive failures")
+
+    def _quarantine(self, r: _Replica, reason: str) -> None:
+        with self._lock:
+            if r.state not in ROUTABLE:
+                return
+            r.state = QUARANTINED
+            self._quarantines += 1
+        log.warning("fleet: quarantining replica %d: %s", r.rid, reason)
+        if r.engine is not None:
+            try:
+                # Fence: queued work fails fast with a typed error and
+                # retries on healthy replicas instead of waiting here.
+                r.engine.kill(f"quarantined: {reason}")
+            except Exception:
+                log.exception("killing replica %d failed", r.rid)
+
+    def _supervise(self) -> None:
+        while not self._stop_event.wait(self.supervisor_poll):
+            for r in self._replicas:
+                with self._lock:
+                    state = r.state
+                    rebuilding = r.rebuilding
+                    rebuilds = r.rebuilds
+                if (
+                    state in ROUTABLE
+                    and r.engine is not None
+                    and not r.engine.health.alive()
+                ):
+                    self._quarantine(
+                        r, f"engine dead: {r.engine.health.reason}"
+                    )
+                    state = QUARANTINED
+                if state == QUARANTINED and not rebuilding:
+                    if rebuilds >= self.max_rebuilds:
+                        with self._lock:
+                            if r.state == QUARANTINED:
+                                r.state = DEAD
+                        log.error(
+                            "fleet: replica %d exhausted its rebuild "
+                            "budget (%d); retiring it", r.rid, rebuilds,
+                        )
+                        continue
+                    with self._lock:
+                        r.rebuilding = True
+                        r.rebuilds += 1
+                    t = threading.Thread(
+                        target=self._rebuild, args=(r,),
+                        name=f"fleet-rebuild-{r.rid}", daemon=True,
+                    )
+                    r.rebuild_thread = t
+                    t.start()
+
+    def _rebuild(self, r: _Replica) -> None:
+        """Background re-warmup of a quarantined replica: fresh engine
+        from the factory, warmed, aligned to the fleet's current weight
+        generation, then reinstated READY."""
+        try:
+            if self._stopped:
+                return  # fleet went away before the build even began
+            eng = self._engine_factory(r.rid)
+            eng.start()
+            with self._lock:
+                weights, gen = self._weights, self._generation
+            if weights is not None and gen > 0:
+                eng.swap_weights(weights, generation=gen)
+            with self._lock:
+                if self._stopped:
+                    pass  # fleet went away mid-rebuild; discard below
+                else:
+                    r.engine = eng
+                    r.state = READY
+                    r.fail_streak = 0
+                    self._reinstatements += 1
+                    eng = None
+            if eng is not None:
+                eng.stop(drain=False)
+            else:
+                log.info("fleet: replica %d reinstated", r.rid)
+        except Exception:
+            log.exception("fleet: rebuild of replica %d failed", r.rid)
+        finally:
+            with self._lock:
+                r.rebuilding = False
+
+
+def build_fleet(
+    cfg,
+    variables,
+    n_replicas: int,
+    buckets: Optional[Sequence[tuple[int, int]]] = None,
+    batch_size: int = 1,
+    int8_head: bool = False,
+    engine_kwargs: Optional[dict] = None,
+    **fleet_kwargs,
+) -> FleetRouter:
+    """Real JAX wiring: replica ``rid`` pins to ``jax.devices()[rid]``
+    (modulo the device count) through the execution plan, so an
+    N-replica fleet on an N-chip host serves one replica per chip."""
+    import jax
+
+    from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+    devices = jax.devices()
+    ekw = dict(engine_kwargs or {})
+
+    def factory(rid: int) -> InferenceEngine:
+        runner = DetectorRunner(
+            cfg, variables,
+            buckets=buckets, batch_size=batch_size, int8_head=int8_head,
+            device=devices[rid % len(devices)],
+        )
+        return InferenceEngine(runner, replica_id=rid, **ekw)
+
+    return FleetRouter(factory, n_replicas, **fleet_kwargs)

@@ -46,6 +46,7 @@ class EngineHealth:
         self,
         clock: Callable[[], float] = time.monotonic,
         latency_window: int = 256,
+        replica_id: Optional[int] = None,
     ) -> None:
         self._clock = clock
         self._lock = threading.Lock()
@@ -55,10 +56,15 @@ class EngineHealth:
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=latency_window
         )
+        self.replica_id = replica_id
         self.shed = 0
         self.deadline_missed = 0
         self.hung = 0
         self.failed = 0
+        # Monotonic weight-swap counter: which weights this engine serves.
+        # The fleet router and loadgen assert response provenance against
+        # it (every served result carries the generation that produced it).
+        self.generation = 0
         self.served: collections.Counter[str] = collections.Counter()
 
     # -- state machine -----------------------------------------------------
@@ -116,6 +122,16 @@ class EngineHealth:
             self.served[level] += 1
             self._latencies.append(latency_s)
 
+    def record_swap(self, generation: int) -> None:
+        """A weight swap completed; ``generation`` must be monotonic."""
+        with self._lock:
+            if generation < self.generation:
+                raise ValueError(
+                    f"weight generation moved backwards: "
+                    f"{self.generation} -> {generation}"
+                )
+            self.generation = generation
+
     # -- snapshot ----------------------------------------------------------
 
     def _percentile(self, values: list[float], q: float) -> Optional[float]:
@@ -143,7 +159,10 @@ class EngineHealth:
                 "deadline_missed": self.deadline_missed,
                 "failed": self.failed,
                 "hung": self.hung,
+                "generation": self.generation,
             }
+            if self.replica_id is not None:
+                out["replica_id"] = self.replica_id
         out["latency_p50_s"] = self._percentile(lat, 0.50)
         out["latency_p90_s"] = self._percentile(lat, 0.90)
         out.update(extra)

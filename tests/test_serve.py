@@ -26,11 +26,14 @@ from mx_rcnn_tpu.serve import (
     DeadlineExceeded,
     EngineHealth,
     EngineUnavailable,
+    FleetRouter,
+    HysteresisPlanner,
     InferenceEngine,
     Overloaded,
     plan_level,
 )
 from mx_rcnn_tpu.serve import health as health_mod
+from mx_rcnn_tpu.serve import router as router_mod
 
 
 class FakeClock:
@@ -136,6 +139,54 @@ class TestCircuitBreaker:
         assert b.allow_full()  # the slot is available again
 
 
+class TestHysteresis:
+    """Degrade ladder x full_q8 interaction: a replica pushed into
+    ``full_q8`` under pressure and hovering at the recovery boundary
+    must not thrash between program families."""
+
+    AVAIL = ("full", "small", "full_q8", "reduced", "proposals")
+    EST = {"full": 1.0, "small": 1.0, "full_q8": 0.05}
+
+    def test_downgrade_is_immediate(self):
+        p = HysteresisPlanner(headroom=1.25, up_margin=1.5, up_dwell=3)
+        assert p.plan(None, {}, True, self.AVAIL) == "full"
+        assert p.plan(1.0, self.EST, True, self.AVAIL) == "full_q8"
+
+    def test_borderline_recovery_does_not_thrash(self):
+        # remaining=1.3 fits plan_level's headroom (1.0 * 1.25 <= 1.3)
+        # so the stateless planner would bounce full_q8 -> full -> back;
+        # the upgrade margin (1.0 * 1.25 * 1.5 = 1.875 > 1.3) holds.
+        p = HysteresisPlanner(headroom=1.25, up_margin=1.5, up_dwell=3)
+        assert p.plan(1.0, self.EST, True, self.AVAIL) == "full_q8"
+        out = [
+            p.plan(r, self.EST, True, self.AVAIL)
+            for r in (1.3, 1.2, 1.3, 1.2, 1.3, 1.3)
+        ]
+        assert out == ["full_q8"] * 6, f"ladder thrashed: {out}"
+
+    def test_sustained_comfort_upgrades_after_dwell(self):
+        p = HysteresisPlanner(headroom=1.25, up_margin=1.5, up_dwell=3)
+        assert p.plan(1.0, self.EST, True, self.AVAIL) == "full_q8"
+        out = [p.plan(5.0, self.EST, True, self.AVAIL) for _ in range(3)]
+        assert out == ["full_q8", "full_q8", "full"]
+
+    def test_comfort_streak_resets_on_borderline(self):
+        avail = ("full", "full_q8", "reduced")
+        p = HysteresisPlanner(headroom=1.25, up_margin=1.5, up_dwell=2)
+        est = {"full": 1.0, "full_q8": 0.05}
+        assert p.plan(1.0, est, True, avail) == "full_q8"
+        assert p.plan(5.0, est, True, avail) == "full_q8"  # streak 1
+        assert p.plan(1.3, est, True, avail) == "full_q8"  # reset
+        assert p.plan(5.0, est, True, avail) == "full_q8"  # streak 1
+        assert p.plan(5.0, est, True, avail) == "full"     # streak 2: up
+
+    def test_no_deadline_counts_toward_dwell(self):
+        p = HysteresisPlanner(headroom=1.25, up_margin=1.5, up_dwell=2)
+        assert p.plan(1.0, self.EST, True, self.AVAIL) == "full_q8"
+        assert p.plan(None, self.EST, True, self.AVAIL) == "full_q8"
+        assert p.plan(None, self.EST, True, self.AVAIL) == "full"
+
+
 class TestHealth:
     def test_legal_lifecycle(self):
         h = EngineHealth()
@@ -174,6 +225,16 @@ class TestHealth:
         assert s["ready"] and s["alive"]
         json.dumps(s)  # dashboard contract: JSON-able
 
+    def test_generation_and_replica_id_in_snapshot(self):
+        h = EngineHealth(replica_id=2)
+        assert h.snapshot()["generation"] == 0
+        assert h.snapshot()["replica_id"] == 2
+        h.record_swap(3)
+        assert h.snapshot()["generation"] == 3
+        with pytest.raises(ValueError, match="backwards"):
+            h.record_swap(1)
+        assert "replica_id" not in EngineHealth().snapshot()
+
 
 # ---------------------------------------------------------------------------
 # engine against a fake runner
@@ -194,15 +255,18 @@ class FakeRunner:
     never trigger."""
 
     def __init__(self, buckets=((64, 64), (128, 128)), batch_size=1,
-                 block: Optional[threading.Event] = None, fail_modes=()):
+                 block: Optional[threading.Event] = None, fail_modes=(),
+                 delay: float = 0.0):
         self.buckets = sorted(
             (tuple(b) for b in buckets), key=lambda b: b[0] * b[1]
         )
         self.batch_size = batch_size
         self.block = block
         self.fail_modes = set(fail_modes)
+        self.delay = delay
         self.compile_count = 0
         self.run_calls = []
+        self.generation = 0
         self._warmed = set()
 
     def levels(self):
@@ -231,15 +295,24 @@ class FakeRunner:
                 self._warmed.add(k)
         return len(self._warmed)
 
+    def swap_weights(self, variables, generation=None):
+        gen = self.generation + 1 if generation is None else int(generation)
+        if gen <= self.generation:
+            raise ValueError("generation must be monotonic")
+        self.generation = gen
+        return gen
+
     def run(self, mode, bucket, images):
         key = (mode, bucket)
         assert key in self._warmed, f"RECOMPILATION on serving path: {key}"
         self.run_calls.append((mode, bucket, len(images)))
+        if self.delay:
+            time.sleep(self.delay)
         if self.block is not None:
             self.block.wait()
         if mode in self.fail_modes:
             raise RuntimeError("injected device failure")
-        return [_det() for _ in images]
+        return [dict(_det(), generation=self.generation) for _ in images]
 
 
 def _img(h, w):
@@ -359,6 +432,240 @@ class TestEngine:
         e.stop()
         with pytest.raises(EngineUnavailable):
             e.submit(_img(8, 8))
+
+    def test_results_carry_weight_generation(self):
+        runner = FakeRunner()
+        with InferenceEngine(runner) as e:
+            assert e.infer(_img(8, 8))["generation"] == 0
+            assert e.swap_weights(None) == 1
+            assert e.infer(_img(8, 8))["generation"] == 1
+            assert e.stats()["generation"] == 1
+
+
+class TestEngineStopDrain:
+    """stop() ordering: admission closes FIRST, every already-accepted
+    request flushes, and only residue fails — typed as "stopping"."""
+
+    def test_drain_flushes_accepted_then_refuses_new(self):
+        gate = threading.Event()
+        runner = FakeRunner(block=gate)
+        e = InferenceEngine(runner, max_queue=8).start()
+        first = e.submit(_img(8, 8))
+        _wait(lambda: e._queue.qsize() == 0 and runner.run_calls)
+        queued = [e.submit(_img(8, 8)) for _ in range(3)]
+        stopper = threading.Thread(target=e.stop, kwargs={"timeout": 10})
+        stopper.start()
+        _wait(lambda: e._draining)
+        with pytest.raises(EngineUnavailable, match="stopping"):
+            e.submit(_img(8, 8))
+        gate.set()
+        stopper.join(10)
+        assert not stopper.is_alive()
+        # Every accepted request was served, none failed by the stop.
+        for r in [first, *queued]:
+            assert r.result(timeout=5)["level"] == "full"
+
+    def test_fast_stop_fails_queued_as_stopping(self):
+        gate = threading.Event()
+        runner = FakeRunner(block=gate)
+        e = InferenceEngine(runner, max_queue=8).start()
+        first = e.submit(_img(8, 8))
+        _wait(lambda: runner.run_calls)
+        queued = e.submit(_img(8, 8))
+        stopper = threading.Thread(
+            target=e.stop, kwargs={"timeout": 5, "drain": False}
+        )
+        stopper.start()
+        gate.set()
+        stopper.join(10)
+        assert not stopper.is_alive()
+        with pytest.raises(EngineUnavailable, match="stopping"):
+            queued.result(timeout=5)
+        assert first.done()
+
+
+# ---------------------------------------------------------------------------
+# fleet routing policy (pure) + router over fake replicas
+# ---------------------------------------------------------------------------
+
+
+def _view(rid, state=router_mod.READY, inflight=0, qd=0,
+          buckets=((64, 64),), gen=0):
+    return router_mod.ReplicaView(
+        rid, state, inflight, qd,
+        tuple(tuple(b) for b in buckets), gen,
+    )
+
+
+class TestRouterPolicy:
+    def test_least_loaded_wins(self):
+        views = [_view(0, inflight=2), _view(1, inflight=0, qd=1),
+                 _view(2, inflight=3)]
+        assert router_mod.select_replica(views).rid == 1
+
+    def test_ready_beats_degraded_at_equal_load(self):
+        views = [_view(0, state=router_mod.DEGRADED), _view(1)]
+        assert router_mod.select_replica(views).rid == 1
+
+    def test_quarantined_and_dead_are_not_routable(self):
+        views = [_view(0, state=router_mod.QUARANTINED),
+                 _view(1, state=router_mod.DEAD)]
+        assert router_mod.select_replica(views) is None
+
+    def test_exclude_skips_tried_replicas(self):
+        views = [_view(0), _view(1, inflight=5)]
+        got = router_mod.select_replica(views, exclude=frozenset({0}))
+        assert got.rid == 1
+        assert router_mod.select_replica(
+            views, exclude=frozenset({0, 1})
+        ) is None
+
+    def test_bucket_preference_with_fallback(self):
+        views = [_view(0, buckets=((64, 64),), inflight=0),
+                 _view(1, buckets=((128, 128),), inflight=5)]
+        assert router_mod.select_replica(
+            views, bucket=(128, 128)
+        ).rid == 1
+        # No replica warmed the bucket: fall back to least-loaded.
+        assert router_mod.select_replica(
+            views, bucket=(256, 256)
+        ).rid == 0
+
+    def test_auto_hedge_delay(self):
+        assert router_mod.auto_hedge_delay({}) is None
+        assert router_mod.auto_hedge_delay(
+            {"full": 0.1}, multiplier=3.0
+        ) == pytest.approx(0.3)
+        assert router_mod.auto_hedge_delay(
+            {"reduced": 0.001}, floor=0.05
+        ) == pytest.approx(0.05)
+
+
+def _fleet(n=3, runner_fn=None, hang_timeout=5.0, **kw):
+    runners = {}
+
+    def factory(rid):
+        r = runner_fn(rid) if runner_fn else FakeRunner(delay=0.005)
+        runners[rid] = r
+        return InferenceEngine(r, replica_id=rid, hang_timeout=hang_timeout)
+
+    kw.setdefault("supervisor_poll", 0.02)
+    return FleetRouter(factory, n, **kw), runners
+
+
+class TestFleet:
+    def test_routes_least_loaded_across_replicas(self):
+        fleet, _ = _fleet(3, runner_fn=lambda rid: FakeRunner(delay=0.05))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(9)]
+            res = [r.result(10) for r in reqs]
+        assert len({r["replica_id"] for r in res}) == 3
+        assert fleet.stats()["failed"] == 0
+
+    def test_replica_kill_loses_no_accepted_requests(self):
+        fleet, _ = _fleet(3, runner_fn=lambda rid: FakeRunner(delay=0.02))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(12)]
+            fleet.kill_replica(1, "test kill")
+            res = [r.result(10) for r in reqs]
+            assert len(res) == 12
+            s = fleet.stats()
+            assert s["failed"] == 0
+            assert s["quarantines"] >= 1
+            # The supervisor rebuilds and reinstates it in the background.
+            _wait(lambda: fleet.stats()["reinstatements"] >= 1)
+            _wait(
+                lambda: fleet.stats()["replica"][1]["state"]
+                == router_mod.READY
+            )
+
+    def test_hedge_first_result_wins_and_dedups(self):
+        gate = threading.Event()
+
+        def runner_fn(rid):
+            # Replica 0 wedges (routing tie-break sends the first
+            # request there); replica 1 stays fast.
+            return FakeRunner(block=gate if rid == 0 else None)
+
+        fleet, _ = _fleet(
+            2, runner_fn=runner_fn, hedge_after=0.05,
+            quarantine_failures=100,
+        )
+        try:
+            with fleet:
+                res = fleet.infer(_img(8, 8), timeout=10)
+                assert res["replica_id"] == 1  # the hedge won
+                s = fleet.stats()
+                assert s["hedges"] == 1
+                assert s["hedge_wins"] == 1
+                assert s["completed"] == 1
+                gate.set()  # release the straggler; its result is dropped
+                assert fleet.stats()["completed"] == 1
+        finally:
+            gate.set()
+
+    def test_failures_retry_then_quarantine_then_reinstate(self):
+        built = []
+
+        def runner_fn(rid):
+            # Replica 0's FIRST engine fails every request; its rebuild
+            # gets a healthy runner (the wedge was transient).  Replica
+            # 1 is slow so load keeps steering submits back onto the
+            # bad replica even after its first failure flips it to
+            # DEGRADED (at equal load the router prefers READY, which
+            # would otherwise leave its fail streak stuck below the
+            # quarantine threshold).
+            bad = rid == 0 and not any(b == 0 for b in built)
+            built.append(rid)
+            fail = set(LEVELS) if bad else set()
+            return FakeRunner(fail_modes=fail, delay=0.0 if bad else 0.05)
+
+        fleet, _ = _fleet(
+            2, runner_fn=runner_fn,
+            quarantine_failures=2, max_attempts=2,
+        )
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(6)]
+            res = [r.result(10) for r in reqs]
+            assert all(r["replica_id"] == 1 for r in res if "replica_id" in r)
+            s = fleet.stats()
+            assert s["failed"] == 0
+            assert s["retries"] >= 1
+            _wait(lambda: fleet.stats()["quarantines"] >= 1)
+            _wait(lambda: fleet.stats()["reinstatements"] >= 1)
+            # The rebuilt replica serves again.
+            _wait(
+                lambda: fleet.stats()["replica"][0]["state"]
+                == router_mod.READY
+            )
+
+    def test_rolling_swap_is_atomic_per_request(self):
+        fleet, runners = _fleet(2)
+        with fleet:
+            assert fleet.infer(_img(8, 8), timeout=10)["generation"] == 0
+            assert fleet.swap_weights({"w": 1}) == 1
+            assert all(r.generation == 1 for r in runners.values())
+            assert fleet.infer(_img(8, 8), timeout=10)["generation"] == 1
+            assert fleet.swap_weights({"w": 2}) == 2
+            assert fleet.generation == 2
+            assert fleet.infer(_img(8, 8), timeout=10)["generation"] == 2
+
+    def test_drain_completes_accepted_then_refuses(self):
+        fleet, _ = _fleet(2, runner_fn=lambda rid: FakeRunner(delay=0.03))
+        fleet.start()
+        reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(8)]
+        assert fleet.drain(timeout=10)
+        for r in reqs:
+            assert r.result(1)["level"] == "full"
+        with pytest.raises(EngineUnavailable, match="stopping"):
+            fleet.submit(_img(8, 8))
+        assert fleet.stats()["failed"] == 0
+
+    def test_submit_before_start_refused(self):
+        fleet, _ = _fleet(1)
+        with pytest.raises(EngineUnavailable, match="not started"):
+            fleet.submit(_img(8, 8))
+        fleet.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,24 @@ Inference scenarios (docs/serving.md) — same real-subprocess discipline:
                 the watchdog must declare the engine dead and fail the
                 waiter with a typed error instead of hanging the client.
 
+Fleet scenarios (serve/fleet.py) — real fleets on 4 fake CPU devices
+(``--xla_force_host_platform_device_count``, one replica per device):
+
+  replica_kill    kill 1 of 4 replicas mid-load: every ACCEPTED request
+                  still completes (failover retry), the replica is
+                  quarantined, rebuilt and reinstated.
+  replica_wedge   one replica's device calls hang: hedged retries keep
+                  latency bounded, the watchdog + supervisor quarantine
+                  the wedge, the rebuild reinstates it.
+  swap_under_load zero-downtime weight swap mid-traffic: every response
+                  bitwise-matches the old-weights or new-weights oracle
+                  for its generation — no request ever sees a
+                  half-swapped tree.
+  fleet_drain     SIGTERM during load: the fleet stops admitting,
+                  every accepted request completes, and the process
+                  exits RESUMABLE_EXIT_CODE (75) — the trainer's
+                  preemption contract, applied to serving.
+
 Bit-identity holds because recovery re-runs the same compiled program
 over the same data schedule from the same restored state — it is the
 strongest possible "nothing was lost, nothing was double-applied" check
@@ -43,8 +61,16 @@ and it needs no tolerance tuning.
 
 Usage:
   python tools/chaos.py [--scenario all|baseline|sigkill|sigterm|nan|truncate
-                                    |eval_sigkill|eval_corrupt|overload|hang]
+                                    |eval_sigkill|eval_corrupt|overload|hang
+                                    |replica_kill|replica_wedge
+                                    |swap_under_load|fleet_drain]
                         [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
+                        [--scenario-timeout SECONDS]
+
+Every scenario runs under a per-scenario wall-clock budget
+(``--scenario-timeout``, default 1.5x ``--timeout``); on expiry the
+orphan reaper SIGKILLs every live child so one wedged scenario cannot
+hang the harness past its budget.
 
 Prints one JSON summary line on stdout; exits non-zero if any scenario
 fails.  (`--child*` / `--compare` are internal subprocess entry modes.)
@@ -60,6 +86,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -86,6 +113,28 @@ def _hermetic_cpu() -> None:
     from mx_rcnn_tpu.utils.compile_cache import configure_cpu_cache
 
     configure_cpu_cache(REPO_ROOT)
+
+
+def _fleet_cpu(n_devices: int = 4) -> None:
+    """Hermetic CPU with ``n_devices`` fake devices (one per replica).
+    Must run before the first jax import — the XLA flag is read at
+    backend init."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    _hermetic_cpu()
+
+
+def _init_variables(cfg, seed: int):
+    import jax
+    from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+
+    return init_detector(
+        TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(seed),
+        cfg.data.image_size,
+    )
 
 
 # -- internal subprocess modes ------------------------------------------------
@@ -201,6 +250,270 @@ def child_hang_main() -> int:
     return 0
 
 
+def child_replica_kill_main() -> int:
+    """Kill 1 of 4 replicas mid-load: zero failed ACCEPTED requests.
+
+    The killed replica's queued/in-flight work fails over via the fleet's
+    retry; the supervisor quarantines, rebuilds and reinstates it."""
+    _fleet_cpu(4)
+    import numpy as np
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.serve import build_fleet
+
+    cfg = get_config(CONFIG)
+    variables = _init_variables(cfg, seed=0)
+    img = np.random.default_rng(0).uniform(
+        0, 255, (100, 100, 3)
+    ).astype(np.float32)
+    fleet = build_fleet(
+        cfg, variables, n_replicas=4,
+        engine_kwargs={"hang_timeout": 300.0},
+        supervisor_poll=0.1,
+    )
+    with fleet:
+        accepted = [fleet.submit(img, timeout=300) for _ in range(6)]
+        wait_for(lambda: any(r.done() for r in accepted), 300)
+        fleet.kill_replica(2, "chaos: replica kill mid-load")
+        accepted += [fleet.submit(img, timeout=300) for _ in range(8)]
+        results = [r.result(timeout=300) for r in accepted]
+        reinstated = wait_for(
+            lambda: fleet.stats()["reinstatements"] >= 1, 300
+        )
+        s = fleet.stats()
+    print(json.dumps({
+        "accepted": len(accepted), "completed": len(results),
+        "failed": s["failed"], "retries": s["retries"],
+        "quarantines": s["quarantines"],
+        "reinstatements": s["reinstatements"],
+        "replicas_used": sorted({r["replica_id"] for r in results}),
+    }))
+    assert len(results) == len(accepted), "an accepted request was lost"
+    assert s["failed"] == 0, f"accepted requests failed: {s}"
+    assert s["quarantines"] >= 1, s
+    assert reinstated, "killed replica was never reinstated"
+    return 0
+
+
+def child_replica_wedge_main() -> int:
+    """One replica's device calls hang forever: hedging keeps latency
+    bounded, the watchdog + supervisor quarantine the wedge, and the
+    background rebuild reinstates the replica."""
+    _fleet_cpu(4)
+    import numpy as np
+
+    import jax
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.serve import FleetRouter, InferenceEngine
+    from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+    cfg = get_config(CONFIG)
+    variables = _init_variables(cfg, seed=0)
+    release = threading.Event()
+    builds = {"n": 0}
+
+    class WedgedRunner:
+        """Delegates to a real runner, but every device call hangs until
+        released — a wedged device stream."""
+
+        def __init__(self, inner) -> None:
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def run(self, mode, bucket, images):
+            release.wait()
+            return self._inner.run(mode, bucket, images)
+
+    devices = jax.devices()
+
+    def factory(rid: int) -> InferenceEngine:
+        runner = DetectorRunner(
+            cfg, variables, device=devices[rid % len(devices)]
+        )
+        builds["n"] += 1
+        if rid == 0 and builds["n"] == 1:
+            runner = WedgedRunner(runner)  # only the FIRST build wedges
+        return InferenceEngine(
+            runner, replica_id=rid, hang_timeout=3.0, watchdog_poll=0.1
+        )
+
+    fleet = FleetRouter(
+        factory, 2, hedge_after=1.0, supervisor_poll=0.1
+    )
+    lat = []
+    with fleet:
+        t0 = time.monotonic()
+        reqs = [fleet.submit(img, timeout=120) for img in [
+            np.random.default_rng(i).uniform(
+                0, 255, (100, 100, 3)
+            ).astype(np.float32) for i in range(8)
+        ]]
+        for r in reqs:
+            r.result(timeout=240)
+            lat.append(time.monotonic() - t0)
+        quarantined = wait_for(
+            lambda: fleet.stats()["quarantines"] >= 1, 120
+        )
+        release.set()  # un-wedge so the stuck worker thread can exit
+        reinstated = wait_for(
+            lambda: fleet.stats()["reinstatements"] >= 1, 300
+        )
+        s = fleet.stats()
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+    print(json.dumps({
+        "completed": len(lat), "failed": s["failed"],
+        "hedges": s["hedges"], "hedge_wins": s["hedge_wins"],
+        "quarantines": s["quarantines"],
+        "reinstatements": s["reinstatements"],
+        "p99_s": round(p99, 3),
+    }))
+    assert s["failed"] == 0, s
+    assert s["hedges"] >= 1, f"wedge never triggered a hedge: {s}"
+    assert quarantined, "wedged replica was never quarantined"
+    assert reinstated, "wedged replica was never reinstated"
+    assert p99 < 60.0, (
+        f"p99 {p99:.1f}s unbounded — hedging failed to contain the wedge"
+    )
+    return 0
+
+
+def child_swap_main() -> int:
+    """Zero-downtime weight swap under load: every response must
+    bitwise-match the old-weights or new-weights oracle for the
+    generation it reports — a half-swapped tree would match neither."""
+    _fleet_cpu(4)
+    import numpy as np
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.serve import build_fleet
+
+    cfg = get_config(CONFIG)
+    v0 = _init_variables(cfg, seed=0)
+    v1 = _init_variables(cfg, seed=1)
+    probe = np.random.default_rng(7).uniform(
+        0, 255, (96, 128, 3)
+    ).astype(np.float32)
+    KEYS = ("boxes", "scores", "classes")
+
+    def sig(res):
+        return {k: np.asarray(res[k]) for k in KEYS}
+
+    def matches(res, oracle) -> bool:
+        return all(
+            np.array_equal(np.asarray(res[k]), oracle[k]) for k in KEYS
+        )
+
+    fleet = build_fleet(
+        cfg, v0, n_replicas=2,
+        engine_kwargs={"hang_timeout": 300.0},
+        supervisor_poll=0.1,
+    )
+    results: list[dict] = []
+    errors: list[str] = []
+    stop = threading.Event()
+
+    def pump() -> None:
+        while not stop.is_set():
+            try:
+                results.append(fleet.infer(probe, timeout=300))
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    with fleet:
+        oracle = {0: sig(fleet.infer(probe, timeout=300))}
+        pumps = [
+            threading.Thread(target=pump, daemon=True) for _ in range(2)
+        ]
+        for t in pumps:
+            t.start()
+        wait_for(lambda: len(results) >= 2, 300)
+        gen = fleet.swap_weights(v1)  # mid-load, rolled replica by replica
+        wait_for(
+            lambda: any(
+                r.get("generation") == gen for r in list(results)
+            ),
+            300,
+        )
+        stop.set()
+        for t in pumps:
+            t.join(300)
+        oracle[gen] = sig(fleet.infer(probe, timeout=300))
+    gens = sorted({r["generation"] for r in results})
+    mismatched = [
+        i for i, r in enumerate(results)
+        if r["generation"] not in oracle
+        or not matches(r, oracle[r["generation"]])
+    ]
+    print(json.dumps({
+        "responses": len(results), "generations_seen": gens,
+        "mismatched": mismatched, "errors": errors,
+        "swap_generation": gen,
+    }))
+    assert not errors, f"requests failed during the swap: {errors}"
+    assert gens == [0, gen], (
+        f"expected traffic on both sides of the swap, saw {gens}"
+    )
+    assert not mismatched, (
+        f"{len(mismatched)} responses matched NEITHER weight version — "
+        "a request saw a half-swapped tree"
+    )
+    return 0
+
+
+def child_fleet_drain_main() -> int:
+    """SIGTERM during load: stop admitting, complete every accepted
+    request, exit RESUMABLE_EXIT_CODE — the trainer's preemption
+    contract (train/preemption.py), applied to the serving fleet."""
+    _fleet_cpu(4)
+    import numpy as np
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.serve import Overloaded, build_fleet
+    from mx_rcnn_tpu.train.preemption import (
+        RESUMABLE_EXIT_CODE,
+        PreemptionGuard,
+    )
+
+    cfg = get_config(CONFIG)
+    variables = _init_variables(cfg, seed=0)
+    img = np.random.default_rng(0).uniform(
+        0, 255, (100, 100, 3)
+    ).astype(np.float32)
+    fleet = build_fleet(
+        cfg, variables, n_replicas=2,
+        engine_kwargs={"hang_timeout": 300.0},
+        supervisor_poll=0.1,
+    )
+    accepted = []
+    with PreemptionGuard() as guard:
+        fleet.start()
+        print("FLEET_READY", flush=True)
+        while not guard.triggered and len(accepted) < 500:
+            try:
+                accepted.append(fleet.submit(img, timeout=300))
+            except Overloaded:
+                time.sleep(0.2)
+                continue
+            time.sleep(0.05)
+        clean = fleet.drain(timeout=240)
+    failed = 0
+    for r in accepted:
+        try:
+            r.result(timeout=1)
+        except Exception:  # noqa: BLE001 - counted, asserted below
+            failed += 1
+    print(json.dumps({
+        "accepted": len(accepted), "failed": failed,
+        "drained_clean": bool(clean),
+        "signal": guard.signum,
+    }), flush=True)
+    assert guard.triggered, "drain ran without a signal — test is vacuous"
+    assert clean, "drain left pending requests behind"
+    assert failed == 0, f"{failed} accepted requests failed during drain"
+    return RESUMABLE_EXIT_CODE
+
+
 def compare_main(dir_a: str, dir_b: str) -> int:
     """Bitwise-compare the newest checkpoints of two run dirs."""
     _hermetic_cpu()
@@ -280,6 +593,26 @@ def metrics_rows(workdir: str) -> list[dict]:
     return rows
 
 
+# Every live chaos subprocess, so a scenario-timeout (or harness exit)
+# can SIGKILL the lot instead of leaving orphans holding the CI budget.
+_LIVE_PROCS: set = set()
+
+
+def reap_orphans() -> int:
+    """SIGKILL every still-live chaos child; returns how many."""
+    reaped = 0
+    for proc in list(_LIVE_PROCS):
+        if proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(5)
+                reaped += 1
+            except Exception:  # noqa: BLE001 - best effort by design
+                pass
+        _LIVE_PROCS.discard(proc)
+    return reaped
+
+
 class Child:
     def __init__(self, workdir: str, argv: list[str],
                  log_name: str = "child-first",
@@ -292,12 +625,14 @@ class Child:
             stdout=self._log, stderr=subprocess.STDOUT,
             env={**os.environ, **(env or {})}, cwd=REPO_ROOT,
         )
+        _LIVE_PROCS.add(self.proc)
 
     def wait(self, timeout: float) -> int:
         try:
             return self.proc.wait(timeout)
         finally:
             self._log.close()
+            _LIVE_PROCS.discard(self.proc)
 
     def signal(self, sig: int) -> None:
         self.proc.send_signal(sig)
@@ -305,6 +640,13 @@ class Child:
     def log_tail(self, n: int = 30) -> str:
         with open(self.log_path) as f:
             return "".join(f.readlines()[-n:])
+
+    def log_contains(self, needle: str) -> bool:
+        try:
+            with open(self.log_path) as f:
+                return needle in f.read()
+        except OSError:
+            return False
 
 
 def wait_for(predicate, timeout: float, poll: float = 0.25):
@@ -573,6 +915,62 @@ def scenario_hang(root: str, steps: int, timeout: float) -> dict:
     return r
 
 
+# -- fleet scenarios ----------------------------------------------------------
+
+
+def scenario_replica_kill(root: str, steps: int, timeout: float) -> dict:
+    r = _json_child(root, "replica_kill", "--child-replica-kill", timeout)
+    assert r["failed"] == 0 and r["completed"] == r["accepted"], r
+    assert r["quarantines"] >= 1 and r["reinstatements"] >= 1, r
+    return r
+
+
+def scenario_replica_wedge(root: str, steps: int, timeout: float) -> dict:
+    r = _json_child(root, "replica_wedge", "--child-replica-wedge", timeout)
+    assert r["failed"] == 0 and r["hedges"] >= 1, r
+    assert r["quarantines"] >= 1 and r["p99_s"] < 60.0, r
+    return r
+
+
+def scenario_swap_under_load(root: str, steps: int, timeout: float) -> dict:
+    r = _json_child(root, "swap_under_load", "--child-swap", timeout)
+    assert not r["mismatched"] and not r["errors"], r
+    assert r["generations_seen"] == [0, r["swap_generation"]], r
+    return r
+
+
+def scenario_fleet_drain(root: str, steps: int, timeout: float) -> dict:
+    """SIGTERM a real serving child mid-load; it must drain and exit 75."""
+    RESUMABLE_EXIT_CODE = 75  # pinned, mirrors train/preemption.py
+
+    wd = os.path.join(root, "fleet_drain")
+    child = Child(
+        wd, [sys.executable, os.path.abspath(__file__),
+             "--child-fleet-drain"],
+        log_name="fleet-drain",
+    )
+    if not wait_for(lambda: child.log_contains("FLEET_READY"), timeout):
+        child.signal(signal.SIGKILL)
+        child.wait(timeout)
+        raise AssertionError(
+            f"fleet never came up within {timeout}s "
+            f"(log: {child.log_path})\n{child.log_tail()}"
+        )
+    time.sleep(2.0)  # let accepted load pile up mid-flight
+    child.signal(signal.SIGTERM)
+    rc = child.wait(timeout)
+    assert rc == RESUMABLE_EXIT_CODE, (
+        f"expected resumable exit {RESUMABLE_EXIT_CODE}, got {rc} "
+        f"(log: {child.log_path})\n{child.log_tail()}"
+    )
+    with open(child.log_path) as f:
+        lines = [ln for ln in f if ln.startswith("{")]
+    assert lines, f"drain child printed no JSON\n{child.log_tail()}"
+    r = json.loads(lines[-1])
+    assert r["accepted"] > 0 and r["failed"] == 0 and r["drained_clean"], r
+    return r
+
+
 SCENARIOS = {
     "baseline": scenario_baseline,
     "sigkill": scenario_sigkill,
@@ -583,6 +981,10 @@ SCENARIOS = {
     "eval_corrupt": scenario_eval_corrupt,
     "overload": scenario_overload,
     "hang": scenario_hang,
+    "replica_kill": scenario_replica_kill,
+    "replica_wedge": scenario_replica_wedge,
+    "swap_under_load": scenario_swap_under_load,
+    "fleet_drain": scenario_fleet_drain,
 }
 
 # Scenarios that restore/compare against baseline's checkpoint.
@@ -604,6 +1006,14 @@ def main(argv=None) -> int:
         return child_overload_main()
     if argv and argv[0] == "--child-hang":
         return child_hang_main()
+    if argv and argv[0] == "--child-replica-kill":
+        return child_replica_kill_main()
+    if argv and argv[0] == "--child-replica-wedge":
+        return child_replica_wedge_main()
+    if argv and argv[0] == "--child-swap":
+        return child_swap_main()
+    if argv and argv[0] == "--child-fleet-drain":
+        return child_fleet_drain_main()
     if argv and argv[0] == "--compare":
         return compare_main(argv[1], argv[2])
 
@@ -617,7 +1027,12 @@ def main(argv=None) -> int:
                    help="keep the scratch root for inspection")
     p.add_argument("--timeout", type=float, default=900.0,
                    help="per-child wall clock budget (seconds)")
+    p.add_argument("--scenario-timeout", type=float, default=None,
+                   help="hard per-scenario budget; on expiry every live "
+                        "child is SIGKILLed and the scenario is marked "
+                        "failed (default: 1.5 x --timeout)")
     args = p.parse_args(argv)
+    scenario_timeout = args.scenario_timeout or 1.5 * args.timeout
 
     root = args.workdir or tempfile.mkdtemp(prefix="mx_rcnn_chaos_")
     names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
@@ -630,12 +1045,32 @@ def main(argv=None) -> int:
     failed = []
     for name in names:
         t0 = time.monotonic()
+        # Hard backstop above the per-child timeout: a scenario whose
+        # orchestration half wedges (not just the child) gets its entire
+        # process tree reaped rather than hanging the suite.
+        timed_out = threading.Event()
+        timer = threading.Timer(
+            scenario_timeout,
+            lambda: (timed_out.set(), reap_orphans()),
+        )
+        timer.daemon = True
+        timer.start()
         try:
             r = SCENARIOS[name](root, args.steps, args.timeout)
             r["ok"] = True
         except (AssertionError, Exception) as e:  # noqa: BLE001 - report all
-            r = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            err = f"{type(e).__name__}: {e}"
+            if timed_out.is_set():
+                err = (f"scenario timed out after {scenario_timeout:.0f}s "
+                       f"(children reaped); {err}")
+            r = {"ok": False, "error": err}
             failed.append(name)
+        finally:
+            timer.cancel()
+            leaked = reap_orphans()
+            if leaked:
+                print(f"[chaos] {name}: reaped {leaked} leftover "
+                      f"subprocess(es)", file=sys.stderr)
         r["seconds"] = round(time.monotonic() - t0, 1)
         results[name] = r
         print(f"[chaos] {name}: {r}", file=sys.stderr)

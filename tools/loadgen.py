@@ -1,0 +1,220 @@
+"""Open-loop load generator for the serving fleet (BENCH_serving).
+
+Drives a real :class:`~mx_rcnn_tpu.serve.fleet.FleetRouter` (tiny model,
+random params, hermetic CPU with one fake device per replica) at a fixed
+arrival rate for a fixed duration and reports the latency distribution
+over *completed* requests plus the fleet's own counters.  Open-loop
+means arrivals are scheduled on the wall clock, not gated on responses —
+a slow fleet falls behind and the backlog shows up as shed requests and
+a fat tail, exactly like production.
+
+Optionally (``--kill-one``) a replica is killed at the midpoint, which
+exercises quarantine -> rebuild -> reinstatement *under load*: the bench
+passes only if accepted requests keep completing and p99 stays under the
+``--assert-p99`` bound while a replica is out.
+
+Prints diagnostics to stderr and exactly one ``BENCH_serving`` JSON line
+as the LAST line on stdout:
+
+    {"bench": "serving", "replicas": 2, "qps": 6.0, "duration_s": 15.0,
+     "submitted": 90, "completed": 88, "shed": 2, "failed": 0,
+     "p50_s": 0.21, "p99_s": 0.57, "max_s": 0.61,
+     "killed_rid": 0, "quarantines": 1, "reinstatements": 1,
+     "hedges": 0, "retries": 1, "generation": 0}
+
+Usage:
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 \\
+    JAX_PLATFORMS=cpu python tools/loadgen.py \\
+        --replicas 2 --qps 6 --duration 15 --kill-one --assert-p99 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _hermetic_cpu(n_devices: int) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def run_bench(args: argparse.Namespace) -> dict:
+    import numpy as np
+
+    import jax
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+    from mx_rcnn_tpu.serve import Overloaded, ServeError, build_fleet
+
+    cfg = get_config(args.config)
+    variables = init_detector(
+        TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
+        cfg.data.image_size,
+    )
+    fleet = build_fleet(
+        cfg, variables, args.replicas,
+        engine_kwargs={"hang_timeout": 300.0, "max_queue": args.max_queue},
+        supervisor_poll=0.1,
+        hedge_after="auto",
+    )
+    print(f"[loadgen] starting {args.replicas} replica(s) "
+          f"(warmup compiles)...", file=sys.stderr)
+    fleet.start()
+    print("[loadgen] fleet ready", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    h, w = cfg.data.image_size
+    images = [rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+              for _ in range(4)]
+
+    lock = threading.Lock()
+    latencies: list[float] = []
+    submitted = shed = failed = 0
+    pending: list = []
+
+    def collect(freq, t_submit: float) -> None:
+        nonlocal failed
+        try:
+            freq.result(timeout=args.deadline + 60.0)
+        except ServeError:
+            with lock:
+                failed += 1
+            return
+        with lock:
+            latencies.append(time.monotonic() - t_submit)
+
+    killed_rid = None
+    interval = 1.0 / args.qps
+    t0 = time.monotonic()
+    next_at = t0
+    deadline_wall = t0 + args.duration
+    while True:
+        now = time.monotonic()
+        if now >= deadline_wall:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.05))
+            continue
+        # Open loop: the schedule advances whether or not this arrival
+        # is admitted, so a slow fleet accumulates lateness (and sheds)
+        # instead of quietly throttling the offered load.
+        next_at += interval
+        if args.kill_one and killed_rid is None and \
+                now - t0 >= args.duration / 2.0:
+            killed_rid = 0
+            fleet.kill_replica(0, "loadgen --kill-one")
+            print(f"[loadgen] killed replica 0 at "
+                  f"t={now - t0:.1f}s", file=sys.stderr)
+        try:
+            freq = fleet.submit(images[submitted % len(images)],
+                                timeout=args.deadline)
+        except Overloaded:
+            with lock:
+                submitted += 1
+                shed += 1
+            continue
+        except ServeError as e:
+            with lock:
+                submitted += 1
+                failed += 1
+            print(f"[loadgen] submit failed: {e}", file=sys.stderr)
+            continue
+        with lock:
+            submitted += 1
+        t = threading.Thread(target=collect, args=(freq, now), daemon=True)
+        t.start()
+        pending.append(t)
+
+    for t in pending:
+        t.join(timeout=args.deadline + 120.0)
+    stats = fleet.stats()
+    # Generous stop budget: --kill-one leaves a background rebuild whose
+    # warmup compile cannot be interrupted; stop() waits it out.
+    fleet.stop(timeout=240.0)
+
+    latencies.sort()
+    rec = {
+        "bench": "serving",
+        "replicas": args.replicas,
+        "qps": args.qps,
+        "duration_s": args.duration,
+        "submitted": submitted,
+        "completed": len(latencies),
+        "shed": shed,
+        "failed": failed,
+        "p50_s": round(_percentile(latencies, 0.50), 4),
+        "p99_s": round(_percentile(latencies, 0.99), 4),
+        "max_s": round(max(latencies), 4) if latencies else float("nan"),
+        "killed_rid": killed_rid,
+        "quarantines": stats["quarantines"],
+        "reinstatements": stats["reinstatements"],
+        "hedges": stats["hedges"],
+        "retries": stats["retries"],
+        "generation": stats["generation"],
+    }
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--qps", type=float, default=6.0,
+                   help="open-loop arrival rate (requests/second)")
+    p.add_argument("--duration", type=float, default=15.0,
+                   help="load window in seconds")
+    p.add_argument("--deadline", type=float, default=120.0,
+                   help="per-request deadline in seconds")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="per-replica admission queue bound")
+    p.add_argument("--config", default="tiny_synthetic")
+    p.add_argument("--kill-one", action="store_true",
+                   help="kill replica 0 at the midpoint of the window")
+    p.add_argument("--assert-p99", type=float, default=None,
+                   help="exit nonzero unless p99 latency (s) is under "
+                        "this bound and no accepted request failed")
+    args = p.parse_args(argv)
+    _hermetic_cpu(args.replicas)
+
+    rec = run_bench(args)
+    print(json.dumps(rec))
+
+    ok = True
+    if rec["completed"] == 0:
+        print("[loadgen] FAIL: no request completed", file=sys.stderr)
+        ok = False
+    if rec["failed"] != 0:
+        print(f"[loadgen] FAIL: {rec['failed']} accepted request(s) "
+              f"failed", file=sys.stderr)
+        ok = False
+    if args.kill_one and rec["quarantines"] < 1:
+        print("[loadgen] FAIL: --kill-one but no quarantine observed",
+              file=sys.stderr)
+        ok = False
+    if args.assert_p99 is not None and not rec["p99_s"] < args.assert_p99:
+        print(f"[loadgen] FAIL: p99 {rec['p99_s']}s >= bound "
+              f"{args.assert_p99}s", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
