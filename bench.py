@@ -285,6 +285,11 @@ def _loader_fed(cfg, step_fn, state, global_batch, n_steps=20):
     n_steps_done = n_calls * k
     img_s = n_calls * k * global_batch / dt
     stall_s, _ = stats.take()
+    # Tear the pipeline down promptly: closing the device_prefetch
+    # generator closes the host prefetch thread, the stacking generator,
+    # and the loader iterator under it — including input-service worker
+    # processes when the run was configured with data.num_workers > 0.
+    it.close()
     h, w = cfg.data.image_size
     platform = jax.default_backend()
     # Data-starvation stage line (satellite of the train_stage_ms

@@ -319,8 +319,20 @@ class DataConfig:
     use_diff: bool = False
     # Parsed-roidb pickle cache directory (reference: imdb.gt_roidb caches
     # under data/cache/<name>_gt_roidb.pkl).  "" disables; entries are
-    # invalidated by the annotation source's mtime.
+    # invalidated by the annotation source's mtime.  Also roots the
+    # checksummed tensor cache (data/cache.py): decoded+letterboxed pixels
+    # memoized under <cache_dir>/tensors/<transform-fingerprint>/ with
+    # per-blob CRCs — corrupt blobs are quarantined and rebuilt, never
+    # served.
     cache_dir: str = ""
+    # Process input service (data/service.py): decode/augment workers as
+    # independent failure domains with deterministic reassignment — the
+    # yielded schedule is bit-identical for any worker count and after any
+    # worker death.  0 (default) keeps the in-process thread pool.
+    num_workers: int = 0
+    # Per-worker-slot respawn budget after a death/wedge; exhausting every
+    # slot degrades to in-process synchronous assembly (run completes).
+    worker_respawns: int = 2
 
 
 @dataclass(frozen=True)

@@ -15,6 +15,12 @@ Two deliberate departures, both TPU-motivated:
     pixels and padded gt boxes.
 """
 
+from mx_rcnn_tpu.data.batch import Batch
+from mx_rcnn_tpu.data.cache import (
+    TensorCache,
+    quarantine_append,
+    quarantine_read,
+)
 from mx_rcnn_tpu.data.datasets import (
     CocoDataset,
     SyntheticDataset,
@@ -23,12 +29,22 @@ from mx_rcnn_tpu.data.datasets import (
 )
 from mx_rcnn_tpu.data.loader import DetectionLoader, load_image, load_proposals
 from mx_rcnn_tpu.data.roidb import filter_roidb, merge_roidb
+from mx_rcnn_tpu.data.service import (
+    InputService,
+    InputServiceDead,
+    InputServiceError,
+)
 from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
 
 __all__ = [
+    "Batch",
     "CocoDataset",
     "DetectionLoader",
+    "InputService",
+    "InputServiceDead",
+    "InputServiceError",
     "SyntheticDataset",
+    "TensorCache",
     "VocDataset",
     "build_dataset",
     "filter_roidb",
@@ -37,4 +53,6 @@ __all__ = [
     "letterbox",
     "merge_roidb",
     "normalize_image",
+    "quarantine_append",
+    "quarantine_read",
 ]

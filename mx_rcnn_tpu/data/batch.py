@@ -1,0 +1,43 @@
+"""The Batch pytree, defined jax-free.
+
+``Batch`` is the contract between the input pipeline and the jitted
+detection graph.  It lives here — not in ``detection/graph.py`` where it
+historically sat — so the input-service worker processes
+(``data/service.py``) can unpickle batches without importing the model
+stack (flax, optax, the Pallas kernels): a spawn worker pays the jax
+import (``mx_rcnn_tpu/__init__`` needs it for the threefry flag) but
+never traces, never initializes a backend, and never loads the detector.
+``detection/graph.py`` re-exports the class, so every historical import
+path keeps working and pickles exchange freely between parent and
+workers.
+
+Fields are numpy arrays on the host side; ``device_prefetch`` /
+``shard_batch`` turn them into device arrays without changing the
+structure (NamedTuple = pytree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+
+class Batch(NamedTuple):
+    """One statically-shaped training/eval batch (data/ produces these)."""
+
+    # (B, H, W, 3): uint8 raw letterboxed pixels (default — normalized
+    # in-graph, see graph.py::prep_images) or float32 already
+    # host-normalized (synthetic in-memory data, data.normalize_on_host).
+    images: Any
+    image_hw: Any     # (B, 2) float32 true (unpadded) height, width
+    gt_boxes: Any     # (B, G, 4)
+    gt_classes: Any   # (B, G) int32, 0 = background/padding
+    gt_valid: Any     # (B, G) bool
+    gt_masks: Optional[Any] = None  # (B, G, Hm, Wm) float32 in [0,1]
+    # COCO crowd / VOC difficult regions: never fg, and anchors/rois covering
+    # them are excluded from bg sampling.  Disjoint from gt_valid slots.
+    gt_ignore: Optional[Any] = None  # (B, G) bool
+    # Externally supplied proposals in letterboxed-image coords, score-desc,
+    # padded (Fast R-CNN mode — the reference's ROIIter/train_rcnn path,
+    # ``rcnn/core/loader.py::ROIIter``).  None = in-graph RPN proposals.
+    ext_rois: Optional[Any] = None   # (B, R, 4)
+    ext_valid: Optional[Any] = None  # (B, R) bool

@@ -1,0 +1,306 @@
+"""Checksummed tensor cache + crash-safe quarantine records.
+
+Two host-side durability primitives for the input pipeline
+(docs/robustness.md "Input service"):
+
+**Quarantine journal** — ``quarantine_append`` writes ONE ``O_APPEND``
+``os.write`` per record, so a record is either fully present or absent:
+concurrent writers (loader threads, service workers via their own loader,
+the cache layer) interleave at line granularity and a crash mid-append
+can leave at most one torn final line, which ``quarantine_read``
+tolerates (skips unparseable lines instead of dying on them).  Records
+carry a wall-clock + monotonic timestamp and a ``reason`` category
+(``io`` | ``annotation`` | ``cache_checksum`` | ``cache_truncated``) so
+chaos scenarios can assert on journal contents.
+
+**TensorCache** — memoizes decoded+letterboxed pixel tensors on disk
+(optionally staged through a RAM LRU) keyed like the compile-cache
+fingerprints (utils/compile_cache.py): the key hashes the record's
+source identity (path+size+mtime, or the pixel bytes for in-memory
+synthetic arrays), the flip flag, and the transform fingerprint (canvas
+/ short / max / normalization), so a config change or a re-decoded file
+can never alias a stale entry.  Every blob carries a CRC32 of its
+payload and is written atomically (tmp + ``os.replace``).  Integrity
+contract: a corrupt or truncated blob is **detected, quarantined to the
+journal, deleted, and rebuilt from source — never served**; the
+``cache_corrupt`` chaos scenario proves the end-to-end run is bitwise
+identical to a cache-less one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+import tempfile
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+# -- quarantine journal -------------------------------------------------------
+
+
+def quarantine_append(path: str, record: dict) -> None:
+    """Append one JSON record crash-safely.
+
+    A single ``write(2)`` on an ``O_APPEND`` fd is atomic with respect to
+    other appenders for this size class, and a crash mid-call tears at
+    most this one line — earlier records are never damaged (contrast the
+    old buffered ``open(path, "a").write`` which could flush half-lines).
+    Timestamps: ``ts`` (epoch seconds, human/cross-run) and ``ts_mono_ns``
+    (monotonic, for in-run ordering asserts — never goes backwards when
+    the wall clock steps).
+    """
+    rec = dict(record)
+    rec.setdefault("ts", round(time.time(), 3))
+    rec.setdefault("ts_mono_ns", time.monotonic_ns())
+    line = (json.dumps(rec) + "\n").encode()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line)
+    finally:
+        os.close(fd)
+
+
+def quarantine_read(path: str) -> list[dict]:
+    """All parseable records; a torn (crash-truncated) trailing line or a
+    corrupt interior line is skipped, not fatal."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "rb") as f:
+        for line in f:
+            try:
+                out.append(json.loads(line.decode("utf-8", "replace")))
+            except ValueError:
+                continue
+    return out
+
+
+# -- tensor cache -------------------------------------------------------------
+
+# Blob layout: MAGIC, u32 header length, JSON header, raw payload bytes.
+# The header carries dtype/shape to rebuild the array and crc32/nbytes to
+# validate the payload before anything is served.
+_MAGIC = b"MXTC1\n"
+_VERSION = 1
+
+
+def transform_fingerprint(cfg) -> str:
+    """Hash of every knob that changes cached pixel bytes (DataConfig).
+
+    Same doctrine as compile_cache: the fingerprint IS the namespace, so
+    changing the letterbox geometry or normalization can never serve a
+    stale tensor — it lands in a different cache directory.
+    """
+    sig = {
+        "v": _VERSION,
+        "image_size": list(cfg.image_size),
+        "short_side": cfg.short_side,
+        "max_side": cfg.max_side,
+        "normalize_on_host": bool(cfg.normalize_on_host),
+        "pixel_mean": list(cfg.pixel_mean),
+        "pixel_std": list(cfg.pixel_std),
+    }
+    return hashlib.sha1(
+        json.dumps(sig, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def record_source_signature(rec) -> str:
+    """Identity of a record's SOURCE pixels.
+
+    On-disk images: path + size + mtime_ns (a re-decoded/replaced file
+    invalidates naturally).  In-memory arrays (synthetic datasets): CRC of
+    the raw bytes — content-addressed, stable across runs of the same
+    deterministic generator.
+    """
+    if rec.image_array is not None:
+        arr = np.ascontiguousarray(rec.image_array)
+        return f"mem:{arr.dtype}:{arr.shape}:{zlib.crc32(arr.view(np.uint8).ravel())}"
+    try:
+        st = os.stat(rec.image_path)
+        return f"file:{rec.image_path}:{st.st_size}:{st.st_mtime_ns}"
+    except OSError:
+        # Unreadable now — key on the path alone; the load itself will
+        # fail and quarantine, nothing gets cached for this record.
+        return f"file:{rec.image_path}:?"
+
+
+class TensorCache:
+    """RAM+disk cache of decoded+letterboxed pixel tensors.
+
+    ``get`` returns ``(pixels, th, tw)`` or None (miss OR quarantined
+    corruption — callers rebuild from source either way and ``put`` the
+    result back).  Returned arrays are marked read-only: entries are
+    shared across batches, and ``np.stack`` in assembly copies them into
+    each batch anyway.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        cfg,
+        ram_bytes: int = 256 << 20,
+        quarantine_path: Optional[str] = None,
+    ) -> None:
+        self.dir = os.path.join(root, "tensors", transform_fingerprint(cfg))
+        os.makedirs(self.dir, exist_ok=True)
+        self.quarantine_path = quarantine_path
+        self._ram_budget = max(int(ram_bytes), 0)
+        self._ram: OrderedDict[str, tuple] = OrderedDict()
+        self._ram_bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+
+    # -- keys --------------------------------------------------------------
+
+    def key(self, rec, flip: bool) -> str:
+        raw = f"{rec.image_id}|{record_source_signature(rec)}|flip={int(flip)}"
+        return hashlib.sha1(raw.encode()).hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.blob")
+
+    # -- blob io -----------------------------------------------------------
+
+    @staticmethod
+    def _encode(pixels: np.ndarray, th: int, tw: int) -> bytes:
+        arr = np.ascontiguousarray(pixels)
+        payload = arr.tobytes()
+        header = json.dumps({
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "th": int(th),
+            "tw": int(tw),
+            "crc32": zlib.crc32(payload),
+            "nbytes": len(payload),
+        }).encode()
+        return _MAGIC + struct.pack("<I", len(header)) + header + payload
+
+    @staticmethod
+    def _decode(blob: bytes) -> tuple:
+        """(pixels, th, tw) or raises ValueError(category-prefixed)."""
+        if len(blob) < len(_MAGIC) + 4 or not blob.startswith(_MAGIC):
+            raise ValueError("cache_truncated: bad magic/short blob")
+        off = len(_MAGIC)
+        (hlen,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if len(blob) < off + hlen:
+            raise ValueError("cache_truncated: header clipped")
+        try:
+            header = json.loads(blob[off:off + hlen])
+        except ValueError as e:
+            raise ValueError(f"cache_truncated: header unparseable ({e})")
+        payload = blob[off + hlen:]
+        if len(payload) != header["nbytes"]:
+            raise ValueError(
+                f"cache_truncated: payload {len(payload)} != "
+                f"{header['nbytes']} bytes"
+            )
+        if zlib.crc32(payload) != header["crc32"]:
+            raise ValueError("cache_checksum: payload crc mismatch")
+        arr = np.frombuffer(payload, dtype=np.dtype(header["dtype"]))
+        arr = arr.reshape(header["shape"])  # frombuffer views are read-only
+        return arr, header["th"], header["tw"]
+
+    # -- public api --------------------------------------------------------
+
+    def get(self, key: str, image_id: str = "?"):
+        with self._lock:
+            hit = self._ram.get(key)
+            if hit is not None:
+                self._ram.move_to_end(key)
+                self.hits += 1
+                return hit
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            self.misses += 1
+            return None
+        try:
+            value = self._decode(blob)
+        except ValueError as e:
+            self._quarantine_blob(key, image_id, e)
+            return None
+        self.hits += 1
+        self._ram_put(key, value)
+        return value
+
+    def put(self, key: str, pixels: np.ndarray, th: int, tw: int) -> None:
+        arr = np.ascontiguousarray(pixels)
+        arr.flags.writeable = False
+        value = (arr, int(th), int(tw))
+        self._ram_put(key, value)
+        path = self._path(key)
+        blob = self._encode(arr, th, tw)
+        # Atomic publish: a reader sees the old blob, the new blob, or no
+        # blob — never a half-written one (a torn write would in any case
+        # be caught by the crc and rebuilt, but why make readers pay).
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # -- internals ---------------------------------------------------------
+
+    def _ram_put(self, key: str, value: tuple) -> None:
+        if not self._ram_budget:
+            return
+        arr = value[0]
+        with self._lock:
+            if key in self._ram:
+                self._ram.move_to_end(key)
+                return
+            self._ram[key] = value
+            self._ram_bytes += arr.nbytes
+            while self._ram_bytes > self._ram_budget and len(self._ram) > 1:
+                _, (old, _, _) = self._ram.popitem(last=False)
+                self._ram_bytes -= old.nbytes
+
+    def _quarantine_blob(
+        self, key: str, image_id: str, error: ValueError
+    ) -> None:
+        """Corrupt blob: journal it, delete it, let the caller rebuild.
+        The blob is NEVER served — detection happens before any bytes
+        reach assembly."""
+        self.corrupt += 1
+        reason = str(error).split(":", 1)[0]
+        if reason not in ("cache_checksum", "cache_truncated"):
+            reason = "cache_checksum"
+        path = self._path(key)
+        log.error(
+            "tensor cache: corrupt blob for image %r (%s) at %s; "
+            "quarantined + rebuilding from source", image_id, error, path,
+        )
+        if self.quarantine_path:
+            quarantine_append(self.quarantine_path, {
+                "image_id": image_id,
+                "path": path,
+                "reason": reason,
+                "error": f"{type(error).__name__}: {error}",
+                "retries": 0,
+            })
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

@@ -14,28 +14,27 @@ compute (the reference relied on MXNet's threaded DataIter for the same).
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import queue
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterable, Iterator, Optional
 
 import numpy as np
 
 from mx_rcnn_tpu.config import DataConfig
+from mx_rcnn_tpu.data.batch import Batch
+from mx_rcnn_tpu.data.cache import TensorCache, quarantine_append
 from mx_rcnn_tpu.data.roidb import RoiRecord
 from mx_rcnn_tpu.data.transforms import (
     flip_boxes,
-    hflip,
     letterbox,
     letterbox_uint8,
     normalize_image,
     oriented_canvas,
     resize_scale,
 )
-from mx_rcnn_tpu.detection.graph import Batch
 
 try:
     import cv2
@@ -188,6 +187,9 @@ class DetectionLoader:
         quarantine_path: Optional[str] = None,
         io_retries: int = 2,
         num_classes: Optional[int] = None,
+        service_workers: Optional[int] = None,
+        worker_respawns: Optional[int] = None,
+        quarantine_announced: Optional[Iterable[str]] = None,
     ) -> None:
         """``proposals``: image_id → {"boxes": (n, 4) ORIGINAL-image coords,
         "scores": (n,)} (the ``test.py --proposals`` pkl format) — shipped
@@ -213,7 +215,10 @@ class DetectionLoader:
         self.quarantine_path = quarantine_path
         self.io_retries = max(int(io_retries), 0)
         self._quarantine_lock = threading.Lock()
-        self._quarantined: set[str] = set()
+        # Pre-announced ids (an input-service worker rebuilding this loader
+        # from the parent's payload): suppress duplicate journal lines for
+        # records the parent already quarantined at construction.
+        self._quarantined: set[str] = set(quarantine_announced or ())
         # Annotation hardening (same contract as pixels): a corrupt or
         # truncated annotation record is detected HERE — before the first
         # epoch touches it — quarantined, and blank-substituted at assembly.
@@ -262,8 +267,35 @@ class DetectionLoader:
             import os as _os
 
             cores = _os.cpu_count() or 1
-            num_workers = min(8, cores) if cores > 1 else 0
-        self.num_workers = num_workers if train else 0
+            num_workers = (min(8, cores) if cores > 1 else 0) if train else 0
+        # In-process thread pool width.  Eval loaders may now use it too
+        # (explicitly requested — the auto heuristic stays train-only so
+        # one-shot eval CLIs don't spin pools up by surprise); assembly is
+        # deterministic, so pooled eval output is byte-identical to sync.
+        self.num_workers = num_workers
+        self._num_classes = num_classes
+        # Process input service (data/service.py): decode workers as
+        # independent failure domains, enabled by data.num_workers > 0 (or
+        # the explicit constructor override).  0 keeps the in-process
+        # thread pool above.  Workers rebuild this loader from a payload
+        # and must never recurse into a service of their own —
+        # _service_assembler pins service_workers=0.
+        if service_workers is None:
+            service_workers = getattr(cfg, "num_workers", 0)
+        self.service_workers = max(int(service_workers), 0)
+        if worker_respawns is None:
+            worker_respawns = getattr(cfg, "worker_respawns", 2)
+        self.worker_respawns = max(int(worker_respawns), 0)
+        # Tensor cache (data/cache.py): decoded+letterboxed pixels memoized
+        # under data.cache_dir, checksummed + atomically written; corrupt
+        # blobs are quarantined to the same journal and rebuilt from
+        # source.  Shared safely between the parent and service workers
+        # (atomic publish, content-addressed keys).
+        self._tensor_cache: Optional[TensorCache] = None
+        if getattr(cfg, "cache_dir", ""):
+            self._tensor_cache = TensorCache(
+                cfg.cache_dir, cfg, quarantine_path=quarantine_path
+            )
         self.proposals = proposals
         self.num_proposals = num_proposals
         self.run_length = max(run_length, 1)
@@ -369,17 +401,17 @@ class DetectionLoader:
             )
             if self.quarantine_path is None:
                 return
-            os.makedirs(
-                os.path.dirname(self.quarantine_path) or ".", exist_ok=True
-            )
-            with open(self.quarantine_path, "a") as f:
-                f.write(json.dumps({
-                    "image_id": rec.image_id,
-                    "path": rec.image_path,
-                    "reason": reason,
-                    "error": f"{type(error).__name__}: {error}",
-                    "retries": retries,
-                }) + "\n")
+            # Crash-safe append (data/cache.py): one O_APPEND write per
+            # record — a kill mid-append tears at most this line, never
+            # earlier ones, and concurrent writers (threads, service
+            # workers) interleave at line granularity.
+            quarantine_append(self.quarantine_path, {
+                "image_id": rec.image_id,
+                "path": rec.image_path,
+                "reason": reason,
+                "error": f"{type(error).__name__}: {error}",
+                "retries": retries,
+            })
 
     def _blank_pixels(self, rec: RoiRecord) -> np.ndarray:
         """A zero canvas in the record's NATIVE dtype — a uint8 blank inside
@@ -405,26 +437,37 @@ class DetectionLoader:
         self._quarantine(rec, err)
         return self._blank_pixels(rec), False
 
-    def _example(self, rec: RoiRecord, flip: bool):
-        if rec.image_id in self._bad_annotations:
-            # Quarantined annotations take the same substitution as
-            # quarantined pixels: blank canvas, zero gt slots.  The stand-in
-            # record never touches the (possibly malformed) box/class arrays.
-            import dataclasses
+    def _pixels(self, rec: RoiRecord, flip: bool):
+        """``(pixels, th, tw, ok)`` — the record's fully processed canvas
+        (decoded, flipped, letterboxed, and normalized where the config
+        says so), independent of any box/gt math.
 
-            rec = dataclasses.replace(
-                rec,
-                boxes=np.zeros((0, 4), np.float32),
-                gt_classes=np.zeros((0,), np.int32),
-                ignore=None,
-                masks=None,
-                image_array=self._blank_pixels(rec),
-                image_path="",
-            )
+        This is the cacheable unit: pixel processing is a pure function of
+        (source bytes, flip, transform config) — exactly the
+        :class:`TensorCache` key — while the box side stays the uniform
+        ``boxes * record_scale`` in the caller.  A cache hit returns the
+        same bytes a rebuild would (the blob stores the final tensor), so
+        hits vs misses are bitwise-invisible downstream — the
+        ``cache_corrupt`` chaos scenario pins that.
+        """
+        cache = self._tensor_cache
+        if cache is not None and (
+            rec.image_id in self._chaos_bad_images
+            or rec.image_id in self._quarantined
+        ):
+            # A record that must exercise the quarantine/substitution path
+            # (or already did) never reads the cache: a stale blob from a
+            # healthier life of the file must not mask the failure.
+            cache = None
+        key = cache.key(rec, flip) if cache is not None else None
+        if cache is not None:
+            hit = cache.get(key, rec.image_id)
+            if hit is not None:
+                img, th, tw = hit
+                return img, th, tw, True
         img, img_ok = self._load_image(rec)
-        boxes = rec.boxes
         if flip:
-            img, boxes = hflip(img, boxes, rec.width)
+            img = img[:, ::-1].copy()  # transforms.hflip's pixel half
         canvas = self.record_canvas(rec)
         scale = self.record_scale(rec)
         nh = int(round(rec.height * scale))
@@ -436,7 +479,6 @@ class DetectionLoader:
             # is also what the reference does (rcnn/io/image.py resizes the
             # uint8 image before the float mean-subtract).
             img = letterbox_uint8(img, canvas, nh, nw)
-            boxes = boxes.astype(np.float32) * scale
             th, tw = nh, nw
         else:
             native = None
@@ -454,16 +496,47 @@ class DetectionLoader:
                 )
             if native is not None:
                 img = native
-                boxes = boxes.astype(np.float32) * scale
                 th, tw = nh, nw
             else:
-                img, boxes, scale, (th, tw) = letterbox(
-                    img.astype(np.float32), boxes, canvas,
-                    self.cfg.short_side, self.cfg.max_side,
+                # letterbox's internal scale is the same min(resize_scale,
+                # ch/h, cw/w) expression as record_scale — identical float
+                # result, so dropping its box output loses nothing.
+                img, _, _, (th, tw) = letterbox(
+                    img.astype(np.float32), np.zeros((0, 4), np.float32),
+                    canvas, self.cfg.short_side, self.cfg.max_side,
                 )
                 img = normalize_image(
                     img, self.cfg.pixel_mean, self.cfg.pixel_std
                 )
+        if img_ok and cache is not None:
+            cache.put(key, img, th, tw)
+        return img, th, tw, img_ok
+
+    def _example(self, rec: RoiRecord, flip: bool):
+        if rec.image_id in self._bad_annotations:
+            # Quarantined annotations take the same substitution as
+            # quarantined pixels: blank canvas, zero gt slots.  The stand-in
+            # record never touches the (possibly malformed) box/class arrays.
+            import dataclasses
+
+            rec = dataclasses.replace(
+                rec,
+                boxes=np.zeros((0, 4), np.float32),
+                gt_classes=np.zeros((0,), np.int32),
+                ignore=None,
+                masks=None,
+                image_array=self._blank_pixels(rec),
+                image_path="",
+            )
+        img, th, tw, img_ok = self._pixels(rec, flip)
+        scale = self.record_scale(rec)
+        boxes = rec.boxes
+        if flip:
+            boxes = flip_boxes(boxes, rec.width)
+        # Uniform box geometry across every pixel path (uint8 / fused C++ /
+        # float letterbox): flip in original coords, then the letterbox
+        # scale — bit-identical to what letterbox itself would emit.
+        boxes = boxes.astype(np.float32) * scale
         g = self.cfg.max_gt_boxes
         n = min(len(boxes), g)
         ign = rec.ignore_flags
@@ -558,29 +631,144 @@ class DetectionLoader:
 
     # -- iteration ---------------------------------------------------------
 
-    def _batch_specs(self):
-        """Infinite (records, flips) stream in GLOBAL epoch order.
+    def _batch_index_specs(self, epochs: Optional[int] = None):
+        """(roidb indices, flips) stream in GLOBAL epoch order — infinite
+        unless ``epochs`` bounds it (tests; production training is open-
+        ended).
 
         The schedule (shuffle order, flip draws) is derived identically on
         every host; multi-host runs slice each global spec to their rank's
-        rows (``_local_rows``), so the flip rng must be consumed for the
-        full global batch here, not per local slice."""
+        rows (``_local_index_spec``), so the flip rng must be consumed for
+        the full global batch here, not per local slice.  Index-based specs
+        are also what ships to input-service workers: a few ints + bools
+        per batch, never pixel bytes."""
         epoch = 0
         rng = np.random.RandomState(self.seed + 17)
-        while True:
+        while epochs is None or epoch < epochs:
             for batch_idx in self._epoch_batches(epoch):
-                recs = [self.roidb[j] for j in batch_idx]
                 flips = [
-                    self.cfg.flip and bool(rng.randint(2)) for _ in recs
+                    self.cfg.flip and bool(rng.randint(2))
+                    for _ in range(len(batch_idx))
                 ]
-                yield recs, flips
+                yield batch_idx, flips
             epoch += 1
+
+    def _batch_specs(self, epochs: Optional[int] = None):
+        """``_batch_index_specs`` with records materialized (legacy shape —
+        tests introspect the schedule through this)."""
+        for batch_idx, flips in self._batch_index_specs(epochs):
+            yield [self.roidb[j] for j in batch_idx], flips
+
+    def _local_index_spec(self, batch_idx, flips):
+        """This host's rows of a global (indices, flips) spec, as plain
+        ints/bools (small, pickles fast to service workers)."""
+        local = self.batch_size // self._world
+        lo = self._rank * local
+        return (
+            [int(j) for j in batch_idx[lo:lo + local]],
+            [bool(f) for f in flips[lo:lo + local]],
+        )
 
     def _local_rows(self, recs, flips):
         """This host's rows of a global (records, flips) spec."""
         local = self.batch_size // self._world
         lo = self._rank * local
         return recs[lo:lo + local], flips[lo:lo + local]
+
+    def _assemble_rows(self, spec) -> Batch:
+        """Assemble one LOCAL (roidb indices, flips) spec — the unit of
+        work for the thread pool and the input service alike."""
+        idxs, flips = spec
+        return self._assemble([self.roidb[j] for j in idxs], flips)
+
+    def _local_spec_stream(self, skip_batches: int = 0,
+                           epochs: Optional[int] = None):
+        """Local (indices, flips) specs with resume fast-forward: spec
+        generation (shuffle order + flip draws) is cheap; skipping specs
+        instead of restarting keeps the resumed run on the same data
+        schedule as an uninterrupted one."""
+        specs = self._batch_index_specs(epochs)
+        for _ in range(skip_batches):
+            try:
+                next(specs)
+            except StopIteration:
+                return
+        for batch_idx, flips in specs:
+            yield self._local_index_spec(batch_idx, flips)
+
+    def _worker_payload(self) -> dict:
+        """Everything a service worker needs to rebuild this loader (spawn
+        semantics: nothing is inherited).  ``quarantine_announced`` carries
+        ids this process already journaled so workers don't re-append
+        duplicate quarantine lines at construction."""
+        return {
+            "roidb": self.roidb,
+            "cfg": self.cfg,
+            "batch_size": self.batch_size,
+            "train": self.train,
+            "seed": self.seed,
+            "rank": self._rank,
+            "world": self._world,
+            "with_masks": self.with_masks,
+            "proposals": self.proposals,
+            "num_proposals": self.num_proposals,
+            "run_length": self.run_length,
+            "quarantine_path": self.quarantine_path,
+            "io_retries": self.io_retries,
+            "num_classes": self._num_classes,
+            "quarantine_announced": sorted(self._quarantined),
+        }
+
+    def _service_batches(self, spec_iter, start_index: int = 0):
+        """Run a local spec stream through the process input service
+        (data/service.py).  Yields in spec order; closing this generator
+        (or exhausting it) tears the service down."""
+        from mx_rcnn_tpu.data.service import InputService
+
+        svc = InputService(
+            specs=spec_iter,
+            assemble=self._assemble_rows,
+            builder=_service_assembler,
+            payload=self._worker_payload(),
+            num_workers=self.service_workers,
+            start_index=start_index,
+            respawns=self.worker_respawns,
+        )
+        try:
+            yield from svc
+        finally:
+            svc.close()
+
+    def _pooled_batches(self, spec_iter) -> Iterator[Batch]:
+        """Thread pool assembling ``num_workers`` batches ahead, yielded in
+        order.  Decode/resize/normalize release the GIL (cv2 and the C++
+        letterbox kernel), so threads give real parallelism — the TPU step
+        is ~2ms/image while host assembly is ~5-10ms/image.  When the spec
+        stream runs dry (bounded epochs, eval shards) the pending deque is
+        DRAINED, not dropped: every scheduled batch is yielded and the
+        generator returns cleanly instead of letting ``next(specs)``
+        escape as a PEP-479 RuntimeError."""
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            pending: collections.deque = collections.deque()
+
+            def pump() -> bool:
+                try:
+                    spec = next(spec_iter)
+                except StopIteration:
+                    return False
+                pending.append(pool.submit(self._assemble_rows, spec))
+                return True
+
+            for _ in range(self.num_workers):
+                if not pump():
+                    break
+            while pending:
+                batch = pending.popleft().result()
+                pump()
+                yield batch
 
     def _poison(self, batch: Batch, idx: int) -> Batch:
         """Chaos hook (CHAOS_NAN_ENV): replace the batch's pixels with NaN."""
@@ -599,39 +787,34 @@ class DetectionLoader:
         if not self._nan_steps:
             yield from it
             return
-        # Both paths below yield batches in global-schedule order, so the
-        # yielded position IS the global batch index.
-        for idx, batch in enumerate(it, start=skip_batches):
-            yield self._poison(batch, idx) if idx in self._nan_steps else batch
-
-    def _raw_train_batches(self, skip_batches: int = 0) -> Iterator[Batch]:
-        specs = self._batch_specs()
-        # Resume fast-forward: spec generation (shuffle order + flip draws)
-        # is cheap; skipping specs instead of restarting keeps the resumed
-        # run on the same data schedule as an uninterrupted one.
-        for _ in range(skip_batches):
-            next(specs)
-        if self.num_workers <= 1:
-            for recs, flips in specs:
-                yield self._assemble(*self._local_rows(recs, flips))
-            return
-        # Worker pool assembling num_workers batches ahead, yielded in
-        # order.  Decode/resize/normalize release the GIL (cv2 and the C++
-        # letterbox kernel), so threads give real parallelism — the TPU
-        # step is ~2ms/image while host assembly is ~5-10ms/image.
-        import collections
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(self.num_workers) as pool:
-            pending = collections.deque(
-                pool.submit(self._assemble, *self._local_rows(*next(specs)))
-                for _ in range(self.num_workers)
-            )
-            while True:
-                pending.append(
-                    pool.submit(self._assemble, *self._local_rows(*next(specs)))
+        # All paths below yield batches in global-schedule order, so the
+        # yielded position IS the global batch index.  NaN poisoning stays
+        # parent-side (after the service): the chaos hook targets the
+        # guardian, not the decode workers.
+        try:
+            for idx, batch in enumerate(it, start=skip_batches):
+                yield (
+                    self._poison(batch, idx) if idx in self._nan_steps
+                    else batch
                 )
-                yield pending.popleft().result()
+        finally:
+            it.close()
+
+    def _raw_train_batches(
+        self, skip_batches: int = 0, epochs: Optional[int] = None
+    ) -> Iterator[Batch]:
+        specs = self._local_spec_stream(skip_batches, epochs)
+        if self.service_workers > 0:
+            # Process input service: decode workers as independent failure
+            # domains (data/service.py).  start_index keys the service's
+            # yield cursor to the GLOBAL batch index so resume and chaos
+            # logs speak the same coordinates as the schedule.
+            yield from self._service_batches(specs, start_index=skip_batches)
+        elif self.num_workers <= 1:
+            for spec in specs:
+                yield self._assemble_rows(spec)
+        else:
+            yield from self._pooled_batches(specs)
 
     def eval_specs(self) -> list[tuple[list[RoiRecord], list[RoiRecord]]]:
         """The GLOBAL eval batch schedule with NO pixel decode: one
@@ -654,31 +837,62 @@ class DetectionLoader:
         construction, and rank-local batches concatenate into exactly the
         single-host global batch.
         """
+        return [
+            ([self.roidb[j] for j in rows], [self.roidb[j] for j in grecs])
+            for (rows, _), grecs in self._eval_index_specs()
+        ]
+
+    def _eval_index_specs(self):
+        """Index-based eval schedule: one ``((local_row_indices, flips),
+        global_record_indices)`` entry per eval batch — the same contract
+        as ``eval_specs`` but picklable-small, so the worker pool and the
+        input service can assemble eval shards too."""
         rank, world = self._rank, self._world
         local = self.batch_size // world
+        idx_all = list(range(len(self.roidb)))
         if self._square_canvas:
-            groups = [self.roidb]
+            groups = [idx_all]
         else:
             groups = [
-                [r for r in self.roidb if r.aspect >= 1],
-                [r for r in self.roidb if r.aspect < 1],
+                [j for j in idx_all if self.roidb[j].aspect >= 1],
+                [j for j in idx_all if self.roidb[j].aspect < 1],
             ]
         specs = []
         for group in groups:
             for i in range(0, len(group), self.batch_size):
-                recs = group[i : i + self.batch_size]
-                pad = self.batch_size - len(recs)
-                padded = recs + [recs[-1]] * pad
-                specs.append((padded[rank * local : (rank + 1) * local], recs))
+                idxs = group[i : i + self.batch_size]
+                pad = self.batch_size - len(idxs)
+                padded = idxs + [idxs[-1]] * pad
+                rows = padded[rank * local : (rank + 1) * local]
+                specs.append(((rows, [False] * len(rows)), idxs))
         return specs
 
     def eval_batch_range(self, start: int = 0, stop: Optional[int] = None):
         """Assemble and yield eval batches ``start:stop`` of the global
         schedule (``eval_specs`` order).  Sharded/resumable evaluation runs
         each shard as one contiguous range and never decodes pixels for
-        batches outside it."""
-        for rows, recs in self.eval_specs()[start:stop]:
-            yield self._assemble(rows, [False] * len(rows)), recs
+        batches outside it.
+
+        Assembly is deterministic, so the thread pool (``num_workers``)
+        and the process service (``service_workers``) produce output
+        byte-identical to the synchronous path — resumable sharded eval
+        keeps its digest contract with either enabled."""
+        specs = self._eval_index_specs()[start:stop]
+        rec_lists = [[self.roidb[j] for j in g] for _, g in specs]
+        row_specs = iter([rows for rows, _ in specs])
+        if self.service_workers > 0:
+            batches: Iterator[Batch] = self._service_batches(
+                row_specs, start_index=start
+            )
+        elif self.num_workers > 1:
+            batches = self._pooled_batches(row_specs)
+        else:
+            batches = (self._assemble_rows(s) for s in row_specs)
+        try:
+            for batch, recs in zip(batches, rec_lists):
+                yield batch, recs
+        finally:
+            batches.close()
 
     def _eval_batches(self, skip_batches: int = 0):
         return self.eval_batch_range(skip_batches)
@@ -693,9 +907,13 @@ class DetectionLoader:
         if not self.train:
             return self._eval_batches(skip_batches)
         it = self._train_batches(skip_batches)
-        if not self.prefetch:
+        if not self.prefetch or self.service_workers > 0:
+            # The input service already overlaps decode with compute via
+            # its worker processes and bounded result queues; a loader
+            # prefetch thread on top would only add a hop (and a second
+            # owner of the service generator).
             return it
-        return _prefetched(it, depth=2)
+        return _Prefetched(it, depth=2)
 
     def record_canvas(self, rec: RoiRecord) -> tuple[int, int]:
         """The static canvas this record letterboxes into (orientation-
@@ -715,20 +933,121 @@ class DetectionLoader:
         )
 
 
-def _prefetched(it: Iterator, depth: int = 2) -> Iterator:
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = object()
+def _service_assembler(payload: dict):
+    """Rebuild the parent's loader inside a spawned service worker and
+    return its ``_assemble_rows`` (module-level so it pickles by reference).
 
-    def worker():
+    ``service_workers=0`` is load-bearing: a worker rebuilding a loader
+    whose config says ``data.num_workers > 0`` must not recurse into a
+    service of its own.  ``prefetch=False`` and ``num_workers=0`` keep the
+    worker single-threaded — its parallelism is the process pool itself.
+    """
+    loader = DetectionLoader(
+        payload["roidb"],
+        payload["cfg"],
+        payload["batch_size"],
+        train=payload["train"],
+        seed=payload["seed"],
+        rank=payload["rank"],
+        world=payload["world"],
+        with_masks=payload["with_masks"],
+        prefetch=False,
+        num_workers=0,
+        proposals=payload["proposals"],
+        num_proposals=payload["num_proposals"],
+        run_length=payload["run_length"],
+        quarantine_path=payload["quarantine_path"],
+        io_retries=payload["io_retries"],
+        num_classes=payload["num_classes"],
+        service_workers=0,
+        worker_respawns=0,
+        quarantine_announced=payload["quarantine_announced"],
+    )
+    return loader._assemble_rows
+
+
+class _Prefetched:
+    """One-deep-ish background prefetch over a batch iterator, with a
+    ``close()`` that actually reclaims the thread.
+
+    The old ``_prefetched`` generator leaked its daemon thread when the
+    consumer stopped early: the thread sat blocked on ``q.put`` against a
+    full queue forever, pinning the source iterator (and any service
+    workers under it) alive.  ``close()`` drains the queue until the
+    thread can finish, joins it, closes the source, and — with
+    ``raise_pending=True`` — re-raises an exception the worker hit that
+    the consumer never got to see (otherwise a source failure after the
+    consumer's last ``next()`` would vanish silently).
+    """
+
+    def __init__(self, it: Iterator, depth: int = 2) -> None:
+        self._it = it
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = object()
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="loader-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
         try:
-            for item in it:
-                q.put(item)
+            for item in self._it:
+                self._q.put(item)
+                if self._closed:
+                    break
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            self._exc = e
         finally:
-            q.put(stop)
+            self._q.put(self._stop)
 
-    threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is stop:
+    def __iter__(self) -> "_Prefetched":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._stop:
+            self._closed = True
+            self._thread.join(timeout=5.0)
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self, raise_pending: bool = True) -> None:
+        """Join the prefetch thread and close the source iterator.  With
+        ``raise_pending`` a worker-side exception the consumer never
+        consumed is re-raised here instead of being swallowed."""
+        if self._closed:
+            self._close_source()
             return
-        yield item
+        self._closed = True
+        # Unblock a worker stuck on a full queue, then wait for its final
+        # stop marker (bounded: the worker checks _closed after each put).
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._close_source()
+        if raise_pending and self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def _close_source(self) -> None:
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except RuntimeError:
+                pass  # generator already executing/closed
+
+
+def _prefetched(it: Iterator, depth: int = 2) -> "_Prefetched":
+    """Legacy alias — prefetching now returns a closeable iterator."""
+    return _Prefetched(it, depth=depth)

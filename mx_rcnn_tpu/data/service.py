@@ -1,0 +1,516 @@
+"""Crash-tolerant multi-process input service.
+
+The decode/augment half of the input pipeline, promoted from threads
+inside the training process to a pool of **independent failure domains**:
+spawned worker processes that can die (OOM-killed decode, a segfaulting
+image codec, chaos SIGKILL) or wedge (stuck NFS read) without taking the
+run down or perturbing the data schedule.
+
+Determinism doctrine (the property every robustness mechanism below must
+preserve): batch CONTENT is a pure function of the global batch index —
+the parent derives the schedule (shuffle order, flip draws) exactly as
+the in-process loader does and ships each batch as a ``(index, spec)``
+task, where the spec is just roidb row indices + flip flags.  Workers
+only assemble pixels; they never draw randomness or see the schedule.
+Results are reordered on the consumer side by index, so the yielded
+stream is **bit-identical for any worker count, after any worker death
+or reassignment, and on resume** — the PR-3 bit-exact chaos guarantee
+holds with workers ON (proved by ``tools/chaos.py --scenario
+data_worker_kill``).
+
+Failure handling mirrors the serving fleet (serve/fleet.py):
+
+- **Heartbeats + watchdog** — each worker stamps a shared heartbeat slot
+  from its main loop only (a wedged decode therefore stales it; a
+  background-thread heartbeat would mask exactly the failure it exists
+  to catch).  The consumer doubles as watchdog: a dead process or a
+  stale heartbeat gets the worker killed.
+- **Deterministic reassignment** — the dead worker's private queues are
+  discarded, its delivered-but-unconsumed results are salvaged, and its
+  remaining in-flight batch indices go back on the pending heap for live
+  workers; the respawned worker starts clean.
+- **Bounded respawns** — each worker slot carries a respawn budget;
+  exhausting every slot raises the typed :class:`InputServiceDead` (or,
+  with ``fallback=True``, degrades to in-process synchronous assembly
+  with a logged health transition — the run completes, slower).
+- **Backpressure** — per-worker result queues are bounded, so workers
+  block (still heartbeating) instead of ballooning host RAM when the
+  consumer is slow.  Per-worker result queues also isolate the failure:
+  a worker SIGKILLed mid-write can only tear its own pipe, which dies
+  with it — a shared queue would corrupt every producer's stream.
+- **Orphan protection** — workers poll ``getppid`` and exit when the
+  parent vanishes (a SIGKILLed parent can run no cleanup), so chaos
+  kills never leak decode processes.
+
+Chaos hooks (tools/chaos.py, real-subprocess scenarios): workers
+self-SIGKILL or wedge on a claimed batch index; an ``O_EXCL`` sentinel
+file makes the claim exclusive, so the reassigned batch does not
+re-trigger the fault on the next worker.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import multiprocessing as mp
+import os
+import queue
+import signal
+import sys
+import time
+from typing import Callable, Iterator, Optional
+
+log = logging.getLogger("mx_rcnn_tpu")
+
+# Watchdog staleness threshold override (seconds, float) — chaos scenarios
+# tighten it so a wedged worker is reaped inside the test budget.
+WATCHDOG_ENV = "MX_RCNN_DATA_WATCHDOG_S"
+# Chaos: "always" or "<global_batch_idx>:<sentinel_path>" — the (first)
+# worker to claim that batch SIGKILLs itself before assembling.
+CHAOS_SUICIDE_ENV = "MX_RCNN_CHAOS_DATA_SUICIDE"
+# Chaos: "<global_batch_idx>:<sentinel_path>" — the claiming worker wedges
+# (sleeps without heartbeating) so the watchdog must reap + reassign.
+CHAOS_WEDGE_ENV = "MX_RCNN_CHAOS_DATA_WEDGE"
+
+_WORKER_DEPTH = 2      # in-flight tasks per worker (decode pipelining)
+_RESULT_DEPTH = 2      # bounded per-worker result queue (backpressure)
+_POLL_S = 0.02         # consumer poll cadence when nothing is ready
+_BOOT_GRACE_S = 120.0  # heartbeat grace for a worker still importing
+
+
+class InputServiceDead(RuntimeError):
+    """Every worker slot is dead and the respawn budget is exhausted."""
+
+
+class InputServiceError(RuntimeError):
+    """A worker's assembly raised — deterministic, so not retried."""
+
+
+def _parse_chaos(env: str, allow_always: bool = False):
+    """``"always"`` or ``"<idx>:<sentinel>"`` → ('always'|int, path|None)."""
+    raw = os.environ.get(env, "").strip()
+    if not raw:
+        return None
+    if raw == "always" and allow_always:
+        return ("always", None)
+    idx, _, sentinel = raw.partition(":")
+    return (int(idx), sentinel or None)
+
+
+def _chaos_claims(spec, idx: int) -> bool:
+    """Does this worker claim the fault for batch ``idx``?  The O_EXCL
+    sentinel makes the claim exclusive across workers AND respawns — the
+    reassigned batch must not re-trigger the same fault forever."""
+    if spec is None:
+        return False
+    target, sentinel = spec
+    if target != "always" and idx != target:
+        return False
+    if sentinel is None:
+        return True
+    try:
+        os.close(os.open(sentinel, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        return True
+    except FileExistsError:
+        return False
+    except OSError:
+        return True
+
+
+def _service_worker(
+    wid: int,
+    builder: Callable,
+    payload: dict,
+    task_q,
+    result_q,
+    heartbeat,
+    parent_pid: int,
+) -> None:
+    """Worker main: pull (idx, spec) tasks, assemble, ship (kind, idx, …).
+
+    The heartbeat is stamped ONLY here, between units of real work — a
+    wedged assemble or a wedged queue therefore reads as stale, which is
+    the watchdog's entire signal.  Workers never initialize a jax
+    backend; they import the package (threefry flag) and the loader, not
+    the model stack.
+    """
+    signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent owns Ctrl-C
+    suicide = _parse_chaos(CHAOS_SUICIDE_ENV, allow_always=True)
+    wedge = _parse_chaos(CHAOS_WEDGE_ENV)
+    assemble = builder(payload)
+    while True:
+        if os.getppid() != parent_pid:
+            os._exit(2)  # orphaned (parent SIGKILLed) — no cleanup to run
+        heartbeat[wid] = time.time()
+        try:
+            task = task_q.get(timeout=0.2)
+        except (queue.Empty, OSError, EOFError):
+            continue
+        if task is None:
+            return
+        idx, spec = task
+        if _chaos_claims(suicide, idx):
+            print(
+                f"[input-service worker {wid}] chaos: self-SIGKILL on "
+                f"batch {idx}", file=sys.stderr, flush=True,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+        if _chaos_claims(wedge, idx):
+            print(
+                f"[input-service worker {wid}] chaos: wedging on batch "
+                f"{idx}", file=sys.stderr, flush=True,
+            )
+            time.sleep(3600.0)  # no heartbeat: the watchdog reaps us
+        try:
+            msg = ("ok", idx, assemble(spec))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            msg = ("err", idx, f"{type(e).__name__}: {e}")
+        while True:
+            heartbeat[wid] = time.time()
+            if os.getppid() != parent_pid:
+                os._exit(2)
+            try:
+                result_q.put(msg, timeout=0.2)
+                break
+            except queue.Full:
+                continue  # backpressure: bounded queue, consumer is slow
+
+
+class _Slot:
+    """One worker's parent-side state: process, private queues, in-flight
+    indices, and the remaining respawn budget."""
+
+    def __init__(self, proc, task_q, result_q, respawns_left: int) -> None:
+        self.proc = proc
+        self.task_q = task_q
+        self.result_q = result_q
+        self.respawns_left = respawns_left
+        self.outstanding: set[int] = set()
+        self.spawned_at = time.time()
+
+
+class InputService:
+    """Deterministic process-pool batch assembly (iterator protocol).
+
+    ``specs`` yields picklable local batch specs in global-schedule
+    order; ``assemble(spec)`` is the parent-side (fallback) assembler;
+    ``builder(payload)`` — both picklable — reconstructs the same
+    assembler inside a spawned worker.  Yields batches in exactly
+    ``specs`` order, whatever happens to the workers.
+    """
+
+    def __init__(
+        self,
+        specs: Iterator,
+        assemble: Callable,
+        builder: Callable,
+        payload: dict,
+        num_workers: int,
+        start_index: int = 0,
+        respawns: int = 2,
+        watchdog_s: Optional[float] = None,
+        fallback: bool = True,
+        name: str = "input-service",
+    ) -> None:
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self._specs = specs
+        self._assemble = assemble
+        self._builder = builder
+        self._payload = payload
+        self._fallback = fallback
+        self._name = name
+        if watchdog_s is None:
+            watchdog_s = float(os.environ.get(WATCHDOG_ENV, "30"))
+        self._watchdog_s = watchdog_s
+        self._boot_grace_s = max(_BOOT_GRACE_S, watchdog_s)
+        # spawn, not fork: the parent has jax (and often a live backend)
+        # loaded — forking a multithreaded jax process deadlocks.
+        self._ctx = mp.get_context("spawn")
+        self._heartbeat = self._ctx.Array("d", num_workers, lock=False)
+        self._slots: list[Optional[_Slot]] = [None] * num_workers
+        for wid in range(num_workers):
+            self._slots[wid] = self._spawn(wid, respawns)
+        # Consumer-side reorder buffer + dispatch window: specs are pulled
+        # at most `window` ahead of the yield cursor, so memory stays
+        # bounded however unevenly workers finish.
+        self._window = max(4, 2 * num_workers * _WORKER_DEPTH)
+        self._pending: list[int] = []   # indices needing (re)assignment
+        self._spec_buf: dict[int, object] = {}  # idx -> spec until yielded
+        self._done: dict[int, object] = {}      # idx -> assembled batch
+        self._next_yield = start_index
+        self._next_spec = start_index
+        self._exhausted = False
+        self._mode = "service"  # -> "sync" after fallback degradation
+        self._closed = False
+        self._last_watchdog = 0.0
+        self.deaths = 0
+        self.reassigned = 0
+        log.info(
+            "%s: %d decode worker(s) (spawn), respawn budget %d/worker, "
+            "watchdog %.1fs", name, num_workers, respawns, watchdog_s,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, wid: int, respawns_left: int) -> _Slot:
+        task_q = self._ctx.Queue()
+        result_q = self._ctx.Queue(maxsize=_RESULT_DEPTH)
+        self._heartbeat[wid] = 0.0  # 0 = not yet booted (grace applies)
+        proc = self._ctx.Process(
+            target=_service_worker,
+            args=(wid, self._builder, self._payload, task_q, result_q,
+                  self._heartbeat, os.getpid()),
+            name=f"{self._name}-worker-{wid}",
+            daemon=True,
+        )
+        proc.start()
+        return _Slot(proc, task_q, result_q, respawns_left)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for slot in self._slots:
+            if slot is None:
+                continue
+            try:
+                slot.task_q.put_nowait(None)
+            except Exception:  # noqa: BLE001 — queue may be broken/full
+                pass
+        for slot in self._slots:
+            if slot is None:
+                continue
+            slot.proc.join(timeout=2.0)
+            if slot.proc.is_alive():
+                slot.proc.kill()
+                slot.proc.join(timeout=2.0)
+            self._discard_queues(slot)
+        self._slots = [None] * len(self._slots)
+
+    @staticmethod
+    def _discard_queues(slot: _Slot) -> None:
+        for q in (slot.task_q, slot.result_q):
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    # -- iterator protocol -------------------------------------------------
+
+    def __iter__(self) -> "InputService":
+        return self
+
+    def __next__(self):
+        if self._mode == "sync":
+            return self._sync_next()
+        while True:
+            if self._next_yield in self._done:
+                batch = self._done.pop(self._next_yield)
+                self._spec_buf.pop(self._next_yield, None)
+                self._next_yield += 1
+                return batch
+            if self._finished():
+                self.close()
+                raise StopIteration
+            self._dispatch()
+            if not self._poll_results():
+                now = time.time()
+                if now - self._last_watchdog >= min(0.2, self._watchdog_s / 4):
+                    self._watchdog(now)
+                    if self._mode == "sync":
+                        return self._sync_next()
+                time.sleep(_POLL_S)
+
+    def _finished(self) -> bool:
+        return (
+            self._exhausted
+            and not self._pending
+            and not self._done
+            and not any(s and s.outstanding for s in self._slots)
+        )
+
+    # -- dispatch / results ------------------------------------------------
+
+    def _dispatch(self) -> None:
+        while True:
+            slot = self._idle_slot()
+            if slot is None:
+                return
+            if self._pending:
+                idx = heapq.heappop(self._pending)
+                spec = self._spec_buf[idx]
+            else:
+                if self._exhausted or self._next_spec >= self._next_yield + self._window:
+                    return
+                try:
+                    spec = next(self._specs)
+                except StopIteration:
+                    self._exhausted = True
+                    return
+                idx = self._next_spec
+                self._next_spec += 1
+                self._spec_buf[idx] = spec
+            slot.outstanding.add(idx)
+            try:
+                slot.task_q.put_nowait((idx, spec))
+            except Exception:  # noqa: BLE001 — broken pipe: watchdog reaps
+                return
+
+    def _idle_slot(self) -> Optional[_Slot]:
+        best = None
+        for slot in self._slots:
+            if slot is None or len(slot.outstanding) >= _WORKER_DEPTH:
+                continue
+            if best is None or len(slot.outstanding) < len(best.outstanding):
+                best = slot
+        return best
+
+    def _poll_results(self) -> bool:
+        got = False
+        for wid, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            while True:
+                try:
+                    msg = slot.result_q.get_nowait()
+                except queue.Empty:
+                    break
+                except Exception as e:  # noqa: BLE001 — torn result pipe
+                    self._fail_slot(wid, f"result stream corrupt ({e})")
+                    break
+                self._accept(slot, msg)
+                got = True
+        return got
+
+    def _accept(self, slot: Optional[_Slot], msg) -> None:
+        kind, idx, val = msg
+        if slot is not None:
+            slot.outstanding.discard(idx)
+        if idx < self._next_yield or idx in self._done:
+            return  # duplicate after reassignment — content is identical
+        if kind == "err":
+            # Assembly is deterministic (the loader already absorbs I/O
+            # flakiness via retry+quarantine inside _assemble), so a raise
+            # here reproduces on any worker: surface it, typed.
+            self.close()
+            raise InputServiceError(
+                f"{self._name}: batch {idx} assembly failed in a worker: "
+                f"{val}"
+            )
+        self._done[idx] = val
+
+    # -- watchdog / failure handling ---------------------------------------
+
+    def _watchdog(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._last_watchdog = now
+        for wid, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            alive = slot.proc.is_alive()
+            hb = self._heartbeat[wid]
+            if hb > 0:
+                stale = now - hb > self._watchdog_s
+            else:  # still booting (spawn + package import)
+                stale = now - slot.spawned_at > self._boot_grace_s
+            if alive and not stale:
+                continue
+            if alive:
+                log.warning(
+                    "%s: worker %d wedged (no heartbeat for %.1fs); killing",
+                    self._name, wid, now - (hb or slot.spawned_at),
+                )
+                slot.proc.kill()
+                slot.proc.join(timeout=5.0)
+                why = "wedged"
+            else:
+                why = f"died (exit {slot.proc.exitcode})"
+            self._fail_slot(wid, why)
+        if all(s is None for s in self._slots):
+            self._go_dead()
+
+    def _fail_slot(self, wid: int, why: str) -> None:
+        slot = self._slots[wid]
+        if slot is None:
+            return
+        self.deaths += 1
+        if slot.proc.is_alive():
+            slot.proc.kill()
+            slot.proc.join(timeout=5.0)
+        # Salvage results the worker delivered before dying — re-assembling
+        # them would be wasted work (content is deterministic either way).
+        while True:
+            try:
+                self._accept(slot, slot.result_q.get_nowait())
+            except queue.Empty:
+                break
+            except Exception:  # noqa: BLE001 — torn pipe dies with worker
+                break
+        # Deterministic reassignment: every in-flight index goes back on
+        # the pending heap; live workers pick them up in index order.
+        lost = sorted(slot.outstanding)
+        for idx in lost:
+            heapq.heappush(self._pending, idx)
+        self.reassigned += len(lost)
+        self._discard_queues(slot)
+        if slot.respawns_left > 0:
+            log.warning(
+                "%s: worker %d %s; reassigning %d in-flight batch(es) %s; "
+                "respawning (%d respawn(s) left)",
+                self._name, wid, why, len(lost), lost,
+                slot.respawns_left - 1,
+            )
+            self._slots[wid] = self._spawn(wid, slot.respawns_left - 1)
+        else:
+            log.error(
+                "%s: worker %d %s; respawn budget exhausted — slot retired "
+                "(%d in-flight batch(es) reassigned)",
+                self._name, wid, why, len(lost),
+            )
+            self._slots[wid] = None
+
+    def _go_dead(self) -> None:
+        """No live workers, no respawn budget: degrade or die — typed."""
+        self.close()
+        if not self._fallback:
+            raise InputServiceDead(
+                f"{self._name}: all workers dead and respawn budget "
+                f"exhausted after {self.deaths} death(s)"
+            )
+        log.error(
+            "%s: all workers dead, respawn budget exhausted (%d deaths); "
+            "falling back to in-process synchronous assembly — the run "
+            "continues degraded", self._name, self.deaths,
+        )
+        self._mode = "sync"
+
+    # -- degraded mode -----------------------------------------------------
+
+    def _sync_next(self):
+        """In-process assembly from the yield cursor onward.  Uses salvaged
+        ``_done`` results first; specs already pulled from the stream sit
+        in ``_spec_buf``, the rest come straight off the iterator — the
+        yielded schedule is unchanged."""
+        idx = self._next_yield
+        if idx in self._done:
+            batch = self._done.pop(idx)
+            self._spec_buf.pop(idx, None)
+            self._next_yield += 1
+            return batch
+        spec = self._spec_buf.pop(idx, None)
+        if spec is None:
+            if self._exhausted:
+                raise StopIteration
+            try:
+                spec = next(self._specs)
+            except StopIteration:
+                self._exhausted = True
+                raise StopIteration from None
+            assert self._next_spec == idx, (
+                f"spec cursor desync: {self._next_spec} != {idx}"
+            )
+            self._next_spec += 1
+        self._next_yield += 1
+        return self._assemble(spec)

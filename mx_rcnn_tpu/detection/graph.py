@@ -44,27 +44,10 @@ from mx_rcnn_tpu.ops.pallas.roi_align import (
 from mx_rcnn_tpu.ops.proposals import Proposals, generate_fpn_proposals
 from mx_rcnn_tpu.ops.roi_align import multilevel_roi_align
 
-
-class Batch(NamedTuple):
-    """One statically-shaped training/eval batch (data/ produces these)."""
-
-    # (B, H, W, 3): uint8 raw letterboxed pixels (default — normalized
-    # in-graph, see prep_images) or float32 already host-normalized
-    # (synthetic in-memory data, data.normalize_on_host=true).
-    images: jnp.ndarray
-    image_hw: jnp.ndarray     # (B, 2) float32 true (unpadded) height, width
-    gt_boxes: jnp.ndarray     # (B, G, 4)
-    gt_classes: jnp.ndarray   # (B, G) int32, 0 = background/padding
-    gt_valid: jnp.ndarray     # (B, G) bool
-    gt_masks: Optional[jnp.ndarray] = None  # (B, G, Hm, Wm) float32 in [0,1]
-    # COCO crowd / VOC difficult regions: never fg, and anchors/rois covering
-    # them are excluded from bg sampling.  Disjoint from gt_valid slots.
-    gt_ignore: Optional[jnp.ndarray] = None  # (B, G) bool
-    # Externally supplied proposals in letterboxed-image coords, score-desc,
-    # padded (Fast R-CNN mode — the reference's ROIIter/train_rcnn path,
-    # ``rcnn/core/loader.py::ROIIter``).  None = in-graph RPN proposals.
-    ext_rois: Optional[jnp.ndarray] = None   # (B, R, 4)
-    ext_valid: Optional[jnp.ndarray] = None  # (B, R) bool
+# Batch moved to data/batch.py (jax-free) so input-service workers can
+# unpickle batches without importing the model stack; re-exported here so
+# every historical `from mx_rcnn_tpu.detection.graph import Batch` holds.
+from mx_rcnn_tpu.data.batch import Batch  # noqa: F401  (re-export)
 
 
 class Detections(NamedTuple):

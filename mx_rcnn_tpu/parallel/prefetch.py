@@ -29,6 +29,7 @@ input-bound signal; it feeds the ``data_stall_ms`` entry of the
 from __future__ import annotations
 
 import collections
+import logging
 import queue
 import threading
 import time
@@ -37,6 +38,8 @@ from typing import Iterator, Optional
 import jax
 
 from mx_rcnn_tpu.parallel.mesh import shard_batch
+
+log = logging.getLogger("mx_rcnn_tpu")
 
 
 class PrefetchStats:
@@ -83,6 +86,7 @@ class _HostPrefetcher:
         self, it: Iterator, depth: int = 1,
         stats: Optional[PrefetchStats] = None,
     ):
+        self._it = it
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
         self._stats = stats
@@ -138,15 +142,50 @@ class _HostPrefetcher:
             raise StopIteration
         return item
 
-    def close(self) -> None:
+    def close(
+        self, raise_pending: bool = False
+    ) -> Optional[BaseException]:
+        """Stop and join the thread, close the source iterator, and
+        surface any exception the producer hit that the consumer never
+        pulled (it would otherwise vanish with the thread).  Returns the
+        pending exception (or re-raises it with ``raise_pending``) so
+        callers choose: the training loop logs it at teardown, the
+        loader-side wrapper propagates it."""
         self._stop.set()
-        # Drain so a producer blocked on put() observes the stop event.
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue.Empty:
-            pass
+        pending: Optional[BaseException] = None
+
+        def drain() -> None:
+            nonlocal pending
+            try:
+                while True:
+                    _, exc = self._q.get_nowait()
+                    if exc is not None and pending is None:
+                        pending = exc
+            except queue.Empty:
+                pass
+
+        # Drain so a producer blocked on put() observes the stop event,
+        # join, then drain again for anything it published while exiting.
+        drain()
         self._thread.join(timeout=5.0)
+        drain()
+        # Close the source chain (generators propagate close to theirs) so
+        # loader prefetch threads and input-service workers are reclaimed,
+        # not leaked behind a dead consumer.  A pending exception the
+        # source surfaces AT close (the loader's own prefetch wrapper does
+        # this) folds into ours — teardown itself must not die on it.
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except RuntimeError:
+                pass  # generator already executing/closed
+            except BaseException as exc:  # noqa: BLE001 — folded, not fatal
+                if pending is None:
+                    pending = exc
+        if pending is not None and raise_pending:
+            raise pending
+        return pending
 
 
 def _timed_pulls(it: Iterator, stats: PrefetchStats) -> Iterator:
@@ -196,4 +235,17 @@ def device_prefetch(
             yield q.popleft()
     finally:
         if isinstance(src, _HostPrefetcher):
-            src.close()
+            pending = src.close()
+            if pending is not None:
+                # The consumer stopped before it would have seen this (a
+                # loader failure mid-read-ahead during early close).  Log
+                # rather than raise: teardown paths (rollback, shutdown)
+                # must not die on a stream the run already abandoned.
+                log.warning(
+                    "host prefetch: source raised after consumer stopped: "
+                    "%s: %s", type(pending).__name__, pending,
+                )
+        else:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()

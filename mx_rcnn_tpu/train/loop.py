@@ -259,18 +259,26 @@ def _warn_config_drift(
 
 def _stacked_batches(it, k: int):
     """Group k consecutive host batches into one (k, B, ...) stacked Batch
-    for a steps_per_call>1 device loop."""
+    for a steps_per_call>1 device loop.  Closing this generator closes its
+    source — the teardown chain (device_prefetch → here → loader iterator
+    → input-service workers) must reach the bottom or worker processes and
+    prefetch threads outlive the run."""
     buf = []
-    for b in it:
-        buf.append(b)
-        if len(buf) == k:
-            yield type(b)(
-                *[
-                    None if fields[0] is None else np.stack(fields)
-                    for fields in zip(*buf)
-                ]
-            )
-            buf = []
+    try:
+        for b in it:
+            buf.append(b)
+            if len(buf) == k:
+                yield type(b)(
+                    *[
+                        None if fields[0] is None else np.stack(fields)
+                        for fields in zip(*buf)
+                    ]
+                )
+                buf = []
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
 
 
 def train(

@@ -1,0 +1,399 @@
+"""Tests for the crash-tolerant input service stack (PR 9).
+
+Covers: the process input service (determinism vs the sync path, worker
+death + deterministic reassignment, respawn-budget exhaustion → typed
+error or sync fallback), the checksummed tensor cache (roundtrip,
+corruption → quarantine → rebuild, key sensitivity), the crash-safe
+quarantine journal (torn-line tolerance), the thread pool's tail-of-epoch
+drain, eval byte-identity across assembly backends, and the closeable
+prefetch wrappers.
+
+Process-spawning tests use tiny roidbs and 1-2 workers so the spawn cost
+(package import per worker) stays a few seconds, not minutes.
+"""
+
+import dataclasses
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import DataConfig
+from mx_rcnn_tpu.data.cache import (
+    TensorCache,
+    quarantine_append,
+    quarantine_read,
+    transform_fingerprint,
+)
+from mx_rcnn_tpu.data.loader import (
+    DetectionLoader,
+    _Prefetched,
+    _service_assembler,
+)
+from mx_rcnn_tpu.data.roidb import RoiRecord
+from mx_rcnn_tpu.data.service import (
+    CHAOS_SUICIDE_ENV,
+    InputService,
+    InputServiceDead,
+)
+
+
+def make_roidb(rng, n=12, h=96, w=128):
+    return [
+        RoiRecord(
+            image_id=f"im{i}", image_path="", height=h, width=w,
+            boxes=np.array([[4.0, 5.0, 60.0, 70.0]], np.float32),
+            gt_classes=np.array([1], np.int32),
+            image_array=(rng.rand(h, w, 3) * 255).astype(np.uint8),
+        )
+        for i in range(n)
+    ]
+
+
+def make_cfg(**kw):
+    base = dict(
+        dataset="synthetic", image_size=(96, 128), short_side=96,
+        max_side=128, max_gt_boxes=8,
+    )
+    base.update(kw)
+    return DataConfig(**base)
+
+
+def assert_batches_equal(a, b):
+    for fa, fb in zip(a, b):
+        if fa is None or fb is None:
+            assert fa is None and fb is None
+            continue
+        np.testing.assert_array_equal(fa, fb)
+
+
+def sync_batches(roidb, cfg, epochs=2, **kw):
+    loader = DetectionLoader(
+        roidb, cfg, batch_size=2, seed=3, prefetch=False, num_workers=0, **kw
+    )
+    return list(loader._raw_train_batches(0, epochs=epochs))
+
+
+class TestPoolDrain:
+    """Tail-of-epoch drain: the thread pool must yield EVERY scheduled
+    batch of a bounded spec stream — the old generator let the terminal
+    ``next(specs)`` StopIteration drop the pending deque (PEP 479)."""
+
+    def test_batch_count_matches_schedule(self, rng):
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        # 12 records / batch 2 = 6 batches per epoch, 2 epochs.
+        want = 12
+        ref = sync_batches(roidb, cfg)
+        assert len(ref) == want
+        for workers in (0, 2, 4):
+            loader = DetectionLoader(
+                roidb, cfg, batch_size=2, seed=3, prefetch=False,
+                num_workers=workers,
+            )
+            got = list(loader._raw_train_batches(0, epochs=2))
+            assert len(got) == want, (
+                f"num_workers={workers} yielded {len(got)}/{want} batches"
+            )
+            for a, b in zip(ref, got):
+                assert_batches_equal(a, b)
+
+
+class TestInputService:
+    def test_service_matches_sync_bitwise(self, rng):
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        ref = sync_batches(roidb, cfg)
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False,
+            num_workers=0, service_workers=2,
+        )
+        got = list(loader._raw_train_batches(0, epochs=2))
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+    def test_resume_skip_matches_sync_tail(self, rng):
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        ref = sync_batches(roidb, cfg)
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False,
+            num_workers=0, service_workers=2,
+        )
+        got = list(loader._raw_train_batches(5, epochs=2))
+        assert len(got) == len(ref) - 5
+        for a, b in zip(ref[5:], got):
+            assert_batches_equal(a, b)
+
+    def test_worker_sigkill_is_bitwise_invisible(self, rng):
+        """SIGKILL a live decode worker mid-stream: its in-flight batches
+        are reassigned and the yielded stream stays bit-identical."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        ref = sync_batches(roidb, cfg)
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False,
+            num_workers=0, service_workers=2, worker_respawns=2,
+        )
+        before = set(p.pid for p in mp.active_children())
+        it = loader._raw_train_batches(0, epochs=2)
+        got = []
+        killed = False
+        for batch in it:
+            got.append(batch)
+            if not killed and len(got) == 2:
+                workers = [
+                    p for p in mp.active_children() if p.pid not in before
+                ]
+                assert workers, "service spawned no visible workers"
+                os.kill(workers[0].pid, signal.SIGKILL)
+                killed = True
+        assert killed
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+    def _service(self, loader, fallback, respawns=0, workers=1, epochs=1):
+        return InputService(
+            specs=loader._local_spec_stream(0, epochs=epochs),
+            assemble=loader._assemble_rows,
+            builder=_service_assembler,
+            payload=loader._worker_payload(),
+            num_workers=workers,
+            respawns=respawns,
+            fallback=fallback,
+        )
+
+    def test_budget_exhausted_raises_typed(self, rng, monkeypatch):
+        monkeypatch.setenv(CHAOS_SUICIDE_ENV, "always")
+        loader = DetectionLoader(
+            make_roidb(rng, n=4), make_cfg(), batch_size=2, seed=3,
+            prefetch=False, num_workers=0,
+        )
+        svc = self._service(loader, fallback=False)
+        try:
+            with pytest.raises(InputServiceDead):
+                list(svc)
+        finally:
+            svc.close()
+
+    def test_budget_exhausted_falls_back_to_sync(self, rng, monkeypatch):
+        monkeypatch.setenv(CHAOS_SUICIDE_ENV, "always")
+        roidb = make_roidb(rng, n=4)
+        cfg = make_cfg()
+        ref = sync_batches(roidb, cfg, epochs=1)
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False, num_workers=0,
+        )
+        svc = self._service(loader, fallback=True)
+        try:
+            got = list(svc)
+        finally:
+            svc.close()
+        assert svc.deaths >= 1
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+
+class TestEvalAssemblyBackends:
+    """Eval shards must be byte-identical whichever backend assembles
+    them — resumable sharded eval fingerprints its outputs."""
+
+    def _eval_range(self, roidb, cfg, **kw):
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, train=False, seed=3, prefetch=False,
+            **kw,
+        )
+        return [b for b, _ in loader.eval_batch_range(0, 4)]
+
+    def test_thread_pool_matches_sync(self, rng):
+        roidb = make_roidb(rng, n=8)
+        cfg = make_cfg()
+        ref = self._eval_range(roidb, cfg, num_workers=0)
+        got = self._eval_range(roidb, cfg, num_workers=4)
+        assert len(got) == len(ref) == 4
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+    def test_process_service_matches_sync(self, rng):
+        roidb = make_roidb(rng, n=8)
+        cfg = make_cfg()
+        ref = self._eval_range(roidb, cfg, num_workers=0)
+        got = self._eval_range(
+            roidb, cfg, num_workers=0, service_workers=2
+        )
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+
+class TestTensorCache:
+    def _cache(self, tmp_path, cfg=None, **kw):
+        return TensorCache(
+            str(tmp_path / "tc"), cfg or make_cfg(),
+            quarantine_path=str(tmp_path / "quarantine.jsonl"), **kw,
+        )
+
+    def test_roundtrip(self, rng, tmp_path):
+        cache = self._cache(tmp_path)
+        rec = make_roidb(rng, n=1)[0]
+        img = (rng.rand(96, 128, 3) * 255).astype(np.uint8)
+        key = cache.key(rec, False)
+        assert cache.get(key, rec.image_id) is None
+        cache.put(key, img, 96, 128)
+        # Disk hit (fresh cache object: no RAM entry).
+        cache2 = self._cache(tmp_path)
+        got, th, tw = cache2.get(key, rec.image_id)
+        assert (th, tw) == (96, 128)
+        np.testing.assert_array_equal(got, img)
+        assert not got.flags.writeable  # entries are shared, not owned
+
+    def test_key_sensitivity(self, rng, tmp_path):
+        cache = self._cache(tmp_path)
+        recs = make_roidb(rng, n=2)
+        assert cache.key(recs[0], False) != cache.key(recs[0], True)
+        assert cache.key(recs[0], False) != cache.key(recs[1], False)
+        # Transform knobs move the fingerprint (a new namespace, so stale
+        # blobs from another geometry can never be served).
+        assert transform_fingerprint(make_cfg()) != transform_fingerprint(
+            make_cfg(image_size=(128, 128), max_side=256)
+        )
+
+    def _blob_paths(self, cache):
+        return sorted(
+            os.path.join(cache.dir, n) for n in os.listdir(cache.dir)
+            if n.endswith(".blob")
+        )
+
+    def test_corruption_quarantined_and_rebuilt(self, rng, tmp_path):
+        cache = self._cache(tmp_path, ram_bytes=0)  # force disk reads
+        rec = make_roidb(rng, n=1)[0]
+        img = (rng.rand(96, 128, 3) * 255).astype(np.uint8)
+        key = cache.key(rec, False)
+        cache.put(key, img, 96, 128)
+        (blob,) = self._blob_paths(cache)
+        with open(blob, "r+b") as f:
+            f.seek(-4, os.SEEK_END)
+            tail = f.read(4)
+            f.seek(-4, os.SEEK_END)
+            f.write(bytes(b ^ 0xFF for b in tail))
+        # Corrupt blob: never served, quarantined, removed from disk.
+        assert cache.get(key, rec.image_id) is None
+        assert cache.corrupt == 1
+        assert not os.path.exists(blob)
+        rows = quarantine_read(str(tmp_path / "quarantine.jsonl"))
+        assert [r["reason"] for r in rows] == ["cache_checksum"]
+        assert rows[0]["image_id"] == rec.image_id
+        # Rebuild: a fresh put round-trips again.
+        cache.put(key, img, 96, 128)
+        got, _, _ = cache.get(key, rec.image_id)
+        np.testing.assert_array_equal(got, img)
+
+    def test_truncation_detected(self, rng, tmp_path):
+        cache = self._cache(tmp_path, ram_bytes=0)
+        rec = make_roidb(rng, n=1)[0]
+        key = cache.key(rec, False)
+        cache.put(key, np.zeros((8, 8, 3), np.uint8), 8, 8)
+        (blob,) = self._blob_paths(cache)
+        with open(blob, "r+b") as f:
+            f.truncate(os.path.getsize(blob) // 2)
+        assert cache.get(key, rec.image_id) is None
+        rows = quarantine_read(str(tmp_path / "quarantine.jsonl"))
+        assert rows[-1]["reason"] == "cache_truncated"
+
+    def test_loader_cache_hits_are_bitwise_invisible(self, rng, tmp_path):
+        roidb = make_roidb(rng, n=6)
+        cfg = make_cfg(cache_dir=str(tmp_path / "tc"))
+        cold = sync_batches(roidb, cfg, epochs=1)
+        warm = sync_batches(roidb, cfg, epochs=1)  # all hits
+        plain = sync_batches(roidb, make_cfg(), epochs=1)  # no cache
+        for a, b, c in zip(cold, warm, plain):
+            assert_batches_equal(a, b)
+            assert_batches_equal(a, c)
+
+
+class TestQuarantineJournal:
+    def test_append_read_and_torn_line(self, tmp_path):
+        path = str(tmp_path / "q.jsonl")
+        quarantine_append(path, {"image_id": "a", "reason": "io"})
+        quarantine_append(path, {"image_id": "b", "reason": "cache_checksum"})
+        # A crash mid-append tears at most the LAST line: simulate one and
+        # require the reader to keep every intact record.
+        with open(path, "a") as f:
+            f.write('{"image_id": "c", "rea')
+        rows = quarantine_read(path)
+        assert [r["image_id"] for r in rows] == ["a", "b"]
+        for r in rows:
+            assert r["ts"] > 0 and r["ts_mono_ns"] > 0
+
+    def test_read_missing_file(self, tmp_path):
+        assert quarantine_read(str(tmp_path / "nope.jsonl")) == []
+
+
+class TestPrefetchClose:
+    def _failing_source(self, n=3):
+        def gen():
+            for i in range(n):
+                yield i
+            raise ValueError("decode exploded")
+
+        return gen()
+
+    def test_prefetched_close_joins_thread(self):
+        pf = _Prefetched(iter(range(100)), depth=2)
+        assert next(pf) == 0
+        pf.close()
+        assert not pf._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_prefetched_close_raises_pending(self):
+        pf = _Prefetched(self._failing_source(), depth=8)
+        assert next(pf) == 0
+        # Give the worker time to hit the failure before close().
+        pf._thread.join(timeout=5.0)
+        with pytest.raises(ValueError, match="decode exploded"):
+            pf.close(raise_pending=True)
+        assert not pf._thread.is_alive()
+
+    def test_prefetched_delivers_exception_in_stream(self):
+        pf = _Prefetched(self._failing_source(n=1), depth=2)
+        assert next(pf) == 0
+        with pytest.raises(ValueError, match="decode exploded"):
+            for _ in pf:
+                pass
+
+    def test_host_prefetcher_close_returns_pending(self):
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        src = self._failing_source()
+        hp = _HostPrefetcher(src, depth=8)
+        assert next(hp) == 0
+        deadline = time.time() + 5.0
+        while hp._thread.is_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        pending = hp.close()
+        assert isinstance(pending, ValueError)
+        assert not hp._thread.is_alive()
+
+    def test_host_prefetcher_close_clean_source(self):
+        from mx_rcnn_tpu.parallel.prefetch import _HostPrefetcher
+
+        closed = []
+
+        class Source:
+            def __iter__(self):
+                return iter(range(4))
+
+            def close(self):
+                closed.append(True)
+
+        hp = _HostPrefetcher(iter(range(4)), depth=2)
+        assert hp.close() is None
+        hp2 = _HostPrefetcher(Source(), depth=2)
+        assert hp2.close() is None
+        assert closed == [True]

@@ -21,6 +21,29 @@ faults the runtime is supposed to survive:
             fall back to the previous step and STILL converge to
             baseline's exact params.
 
+Data-path scenarios (data/service.py, data/cache.py) — every train child
+above already runs the production input path (process decode workers +
+checksummed tensor cache via ``--set data.num_workers/cache_dir``), so
+baseline vs sigkill/sigterm doubles as the cache-hit-vs-miss bitwise
+proof; these four inject data-specific faults on top:
+
+  data_worker_kill   a decode worker SIGKILLs itself mid-epoch (armed via
+                     MX_RCNN_CHAOS_DATA_SUICIDE); its in-flight batches
+                     are reassigned deterministically and the final
+                     params are BIT-IDENTICAL to baseline's.
+  data_worker_wedge  a worker wedges (no heartbeat); the watchdog reaps
+                     + respawns it, the run completes bit-identical, and
+                     the per-interval data_stall_ms stays bounded (the
+                     wedge never leaks into the wait).
+  cache_corrupt      flip bytes inside a cached tensor blob; the next run
+                     detects the bad checksum, quarantines + rebuilds the
+                     blob, completes, and stays bit-identical — corrupt
+                     bytes are never served.
+  data_service_dead  every worker dies until the respawn budget is
+                     exhausted (suicide "always"); the service degrades
+                     to in-process synchronous assembly and the run
+                     STILL completes bit-identical.
+
 Inference scenarios (docs/serving.md) — same real-subprocess discipline:
 
   eval_sigkill  SIGKILL a --resumable eval once shard checkpoints are on
@@ -61,11 +84,17 @@ and it needs no tolerance tuning.
 
 Usage:
   python tools/chaos.py [--scenario all|baseline|sigkill|sigterm|nan|truncate
+                                    |data_worker_kill|data_worker_wedge
+                                    |cache_corrupt|data_service_dead
                                     |eval_sigkill|eval_corrupt|overload|hang
                                     |replica_kill|replica_wedge
                                     |swap_under_load|fleet_drain]
                         [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
                         [--scenario-timeout SECONDS]
+
+``--scenario`` also takes a comma-separated list (e.g.
+``--scenario data_worker_kill,cache_corrupt``) — scenarios share the
+workdir, so baseline runs once and is reused.
 
 Every scenario runs under a per-scenario wall-clock budget
 (``--scenario-timeout``, default 1.5x ``--timeout``); on expiry the
@@ -539,13 +568,28 @@ def compare_main(dir_a: str, dir_b: str) -> int:
 # -- orchestrator -------------------------------------------------------------
 
 
-def train_argv(workdir: str, steps: int, resume: bool = False) -> list[str]:
+def train_argv(workdir: str, steps: int, resume: bool = False,
+               cache_dir: str | None = None, service_workers: int = 2,
+               respawns: int = 2) -> list[str]:
+    # Every train child runs the PRODUCTION input path: process decode
+    # workers + the checksummed tensor cache.  The cache root is shared
+    # across sibling scenarios by default (one level above the per-
+    # scenario workdir): baseline populates it cold, sigkill/sigterm/
+    # truncate resume against it warm — so the standing bit-identity
+    # comparisons double as the cache-hit-vs-miss bitwise proof.
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(workdir)), "tensor_cache"
+        )
     argv = [
         sys.executable, os.path.abspath(__file__), "--child", "--",
         "--config", CONFIG, "--workdir", workdir,
         "--steps", str(steps), "--no-eval",
         "--set", f"train.checkpoint_every={CKPT_EVERY}",
         "--set", f"train.log_every={LOG_EVERY}",
+        "--set", f"data.num_workers={service_workers}",
+        "--set", f"data.worker_respawns={respawns}",
+        "--set", f"data.cache_dir={cache_dir}",
     ]
     if resume:
         argv.append("--resume")
@@ -671,9 +715,10 @@ def run_argv_to_completion(workdir: str, argv: list[str], timeout: float,
 
 
 def run_to_completion(workdir: str, steps: int, timeout: float,
-                      resume: bool = False, env: dict | None = None) -> int:
+                      resume: bool = False, env: dict | None = None,
+                      **argv_kw) -> int:
     return run_argv_to_completion(
-        workdir, train_argv(workdir, steps, resume), timeout,
+        workdir, train_argv(workdir, steps, resume, **argv_kw), timeout,
         log_name=f"child-{'resume' if resume else 'first'}", env=env,
     )
 
@@ -798,6 +843,180 @@ def scenario_truncate(root: str, steps: int, timeout: float) -> dict:
     )
     return {"truncated_step": latest, "files_clipped": clipped,
             "bit_identical": True}
+
+
+# -- data-path scenarios ------------------------------------------------------
+
+
+def _child_log(workdir: str, name: str = "child-first") -> str:
+    try:
+        with open(os.path.join(workdir, f"{name}.log")) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def scenario_data_worker_kill(root: str, steps: int, timeout: float) -> dict:
+    """SIGKILL one decode worker mid-epoch (the worker self-kills on a
+    claimed batch index); its in-flight batches are reassigned and the
+    final params must be bitwise-identical to the uninterrupted run."""
+    wd = os.path.join(root, "data_worker_kill")
+    os.makedirs(wd, exist_ok=True)
+    sentinel = os.path.join(wd, "suicide.sentinel")
+    kill_idx = CKPT_EVERY + 1  # mid-epoch, past the first checkpoint
+    run_to_completion(
+        wd, steps, timeout,
+        env={"MX_RCNN_CHAOS_DATA_SUICIDE": f"{kill_idx}:{sentinel}"},
+    )
+    assert finalized_steps(wd)[-1] == steps
+    assert os.path.exists(sentinel), (
+        "no worker ever claimed the suicide fault — the service path "
+        "did not run"
+    )
+    logtxt = _child_log(wd)
+    assert "chaos: self-SIGKILL" in logtxt, "worker never self-killed"
+    assert "respawning" in logtxt, (
+        "dead worker was never respawned (watchdog missed the death)"
+    )
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "params diverged after a decode-worker SIGKILL — reassignment "
+        "is not schedule-deterministic"
+    )
+    return {"killed_batch": kill_idx, "bit_identical": True}
+
+
+def scenario_data_worker_wedge(root: str, steps: int, timeout: float) -> dict:
+    """One worker wedges (sleeps without heartbeating); the tightened
+    watchdog must reap + respawn it, the run completes bit-identical, and
+    per-interval data_stall_ms stays bounded by the watchdog — the wedge
+    sleep itself (3600s) must never leak into the consumer wait."""
+    wd = os.path.join(root, "data_worker_wedge")
+    os.makedirs(wd, exist_ok=True)
+    sentinel = os.path.join(wd, "wedge.sentinel")
+    wedge_idx = CKPT_EVERY + 1
+    watchdog_s = 4.0
+    run_to_completion(
+        wd, steps, timeout,
+        env={
+            "MX_RCNN_CHAOS_DATA_WEDGE": f"{wedge_idx}:{sentinel}",
+            "MX_RCNN_DATA_WATCHDOG_S": str(watchdog_s),
+        },
+    )
+    assert finalized_steps(wd)[-1] == steps
+    assert os.path.exists(sentinel), "no worker ever claimed the wedge"
+    logtxt = _child_log(wd)
+    assert "wedged" in logtxt, "watchdog never reaped the wedged worker"
+    assert "respawning" in logtxt
+    stalls = [
+        r["data_stall_ms"] for r in metrics_rows(wd)
+        if "data_stall_ms" in r
+    ]
+    assert stalls, "no data_stall_ms rows — stall metering is dark"
+    bound_ms = 30_000.0  # generous: watchdog 4s + respawn + CPU decode
+    assert max(stalls) < bound_ms, (
+        f"data_stall_ms peaked at {max(stalls):.0f}ms — the wedge leaked "
+        f"past the {watchdog_s:.0f}s watchdog"
+    )
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "params diverged after a wedged decode worker"
+    )
+    return {"wedged_batch": wedge_idx, "max_stall_ms": round(max(stalls), 1),
+            "bit_identical": True}
+
+
+def _blob_valid(path: str) -> bool:
+    """Inline tensor-blob integrity check (mirrors data/cache.py's layout:
+    magic, u32 header len, JSON header with crc32/nbytes, payload) — the
+    orchestrator stays package-import-free."""
+    import struct
+    import zlib
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    magic = b"MXTC1\n"
+    if not blob.startswith(magic) or len(blob) < len(magic) + 4:
+        return False
+    (hlen,) = struct.unpack_from("<I", blob, len(magic))
+    try:
+        header = json.loads(blob[len(magic) + 4 : len(magic) + 4 + hlen])
+    except ValueError:
+        return False
+    payload = blob[len(magic) + 4 + hlen :]
+    return (
+        len(payload) == header["nbytes"]
+        and zlib.crc32(payload) == header["crc32"]
+    )
+
+
+def scenario_cache_corrupt(root: str, steps: int, timeout: float) -> dict:
+    """Bit-rot a cached tensor blob between two runs sharing the cache:
+    the second run must detect the checksum mismatch, quarantine + rebuild
+    the blob, complete, and stay bitwise-identical to baseline — corrupt
+    cache bytes are never served."""
+    import glob as _glob
+
+    wd = os.path.join(root, "cache_corrupt")
+    cache = os.path.join(wd, "tensor_cache")  # private: we poison it
+    wd_a = os.path.join(wd, "populate")
+    run_to_completion(wd_a, steps, timeout, cache_dir=cache)
+    assert finalized_steps(wd_a)[-1] == steps
+    blobs = sorted(_glob.glob(os.path.join(cache, "tensors", "*", "*.blob")))
+    assert blobs, f"populate run wrote no tensor blobs under {cache}"
+    victim = blobs[0]
+    with open(victim, "r+b") as f:
+        f.seek(-8, os.SEEK_END)
+        tail = f.read(8)
+        f.seek(-8, os.SEEK_END)
+        f.write(bytes(b ^ 0xFF for b in tail))  # flip payload bytes
+    assert not _blob_valid(victim), "corruption did not take"
+    wd_b = os.path.join(wd, "repair")
+    run_to_completion(wd_b, steps, timeout, cache_dir=cache)
+    assert finalized_steps(wd_b)[-1] == steps
+    qpath = os.path.join(wd_b, CONFIG, "quarantine.jsonl")
+    assert os.path.exists(qpath), "corrupt blob was never quarantined"
+    reasons = set()
+    with open(qpath) as f:
+        for line in f:
+            try:
+                reasons.add(json.loads(line).get("reason"))
+            except ValueError:
+                pass
+    assert "cache_checksum" in reasons, (
+        f"expected a cache_checksum quarantine record, got {sorted(reasons)}"
+    )
+    assert _blob_valid(victim), (
+        "corrupt blob was not rebuilt in place (repair run left it rotten)"
+    )
+    assert bitwise_equal(os.path.join(root, "baseline"), wd_b, timeout), (
+        "params diverged after cache corruption — corrupt bytes reached "
+        "training"
+    )
+    return {"corrupted_blob": os.path.basename(victim),
+            "quarantine_reasons": sorted(r for r in reasons if r),
+            "bit_identical": True}
+
+
+def scenario_data_service_dead(root: str, steps: int, timeout: float) -> dict:
+    """Every worker dies on its first task ("always" suicide) until the
+    respawn budget is exhausted; the service must degrade to in-process
+    synchronous assembly and the run must STILL complete bit-identical."""
+    wd = os.path.join(root, "data_service_dead")
+    run_to_completion(
+        wd, steps, timeout, respawns=1,
+        env={"MX_RCNN_CHAOS_DATA_SUICIDE": "always"},
+    )
+    assert finalized_steps(wd)[-1] == steps
+    logtxt = _child_log(wd)
+    assert "respawn budget exhausted" in logtxt, (
+        "service never exhausted its respawn budget"
+    )
+    assert "falling back to in-process synchronous assembly" in logtxt, (
+        "service died without the logged degradation transition"
+    )
+    assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
+        "sync-fallback params differ from the uninterrupted run"
+    )
+    return {"fallback": "sync", "bit_identical": True}
 
 
 # -- inference scenarios ------------------------------------------------------
@@ -977,6 +1196,10 @@ SCENARIOS = {
     "sigterm": scenario_sigterm,
     "nan": scenario_nan,
     "truncate": scenario_truncate,
+    "data_worker_kill": scenario_data_worker_kill,
+    "data_worker_wedge": scenario_data_worker_wedge,
+    "cache_corrupt": scenario_cache_corrupt,
+    "data_service_dead": scenario_data_service_dead,
     "eval_sigkill": scenario_eval_sigkill,
     "eval_corrupt": scenario_eval_corrupt,
     "overload": scenario_overload,
@@ -990,6 +1213,8 @@ SCENARIOS = {
 # Scenarios that restore/compare against baseline's checkpoint.
 NEEDS_BASELINE = {
     "sigkill", "sigterm", "truncate", "eval_sigkill", "eval_corrupt",
+    "data_worker_kill", "data_worker_wedge", "cache_corrupt",
+    "data_service_dead",
 }
 
 
@@ -1019,7 +1244,9 @@ def main(argv=None) -> int:
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--scenario", default="all",
-                   choices=["all", *SCENARIOS])
+                   help="'all', one scenario name, or a comma-separated "
+                        "list (baseline is prepended automatically when a "
+                        "listed scenario needs it)")
     p.add_argument("--steps", type=int, default=12)
     p.add_argument("--workdir", default=None,
                    help="scratch root (default: a fresh temp dir)")
@@ -1035,7 +1262,14 @@ def main(argv=None) -> int:
     scenario_timeout = args.scenario_timeout or 1.5 * args.timeout
 
     root = args.workdir or tempfile.mkdtemp(prefix="mx_rcnn_chaos_")
-    names = list(SCENARIOS) if args.scenario == "all" else [args.scenario]
+    if args.scenario == "all":
+        names = list(SCENARIOS)
+    else:
+        names = [n.strip() for n in args.scenario.split(",") if n.strip()]
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            p.error(f"unknown scenario(s) {unknown}; "
+                    f"known: {', '.join(SCENARIOS)}")
     # Recovery scenarios restore/compare baseline's checkpoint; pure
     # engine scenarios (overload/hang) don't pay for a training run.
     if "baseline" not in names and NEEDS_BASELINE & set(names):
