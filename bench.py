@@ -641,6 +641,13 @@ def main() -> None:
                 "value": round(img_s, 3),
                 "unit": "img/s/chip",
                 "vs_baseline": round(img_s / BASELINE_IMG_S_CHIP, 4),
+                # Per-step wall-clock tail (StepTimer, synced upper bound):
+                # mean/p50/p90/p99/max in ms — a throughput headline can
+                # hide a straggler step; these cannot.
+                "step_ms": {
+                    key: round(v, 3)
+                    for key, v in per_step.items() if key != "steps"
+                },
             }
         )
     )

@@ -37,6 +37,13 @@ TPU005 unscoped-mxu     conv/dot-emitting calls in a plain function with
                         module scope — their FLOPs land in hlo_profile's
                         "other" bucket, breaking per-component MFU
                         attribution.
+TPU007 obs-in-trace     any import of ``mx_rcnn_tpu.obs`` in traced code.
+                        The observability plane is host-side by contract
+                        (journal writes, HTTP endpoint, wall clocks): an
+                        emit/span/counter inside a jitted module would at
+                        best bake trace-time values and at worst sync or
+                        do I/O per step.  (TPU006 is the dynamic bf16
+                        upcast walk in tools/tpulint.py.)
 """
 
 from __future__ import annotations
@@ -70,6 +77,8 @@ RULES: dict[str, str] = {
               "(trace-order nondeterminism)",
     "TPU005": "MXU-emitting op outside any jax.named_scope / flax module "
               "(unattributable FLOPs)",
+    "TPU007": "mx_rcnn_tpu.obs imported in jit-traced code (the "
+              "observability plane is host-side only)",
 }
 
 # TPU001: numpy calls that materialize/cast an array on host.
@@ -223,10 +232,22 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Import(self, node: ast.Import) -> None:
         self.imports.visit_import(node)
+        for a in node.names:
+            if a.name == "mx_rcnn_tpu.obs" or a.name.startswith(
+                "mx_rcnn_tpu.obs."
+            ):
+                self._emit("TPU007", node)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         self.imports.visit_import_from(node)
+        mod = node.module or ""
+        if mod == "mx_rcnn_tpu.obs" or mod.startswith("mx_rcnn_tpu.obs."):
+            self._emit("TPU007", node)
+        elif mod == "mx_rcnn_tpu" and any(
+            a.name == "obs" for a in node.names
+        ):
+            self._emit("TPU007", node)
         self.generic_visit(node)
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:

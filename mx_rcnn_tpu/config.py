@@ -400,11 +400,38 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability plane (mx_rcnn_tpu/obs/): typed journal, metrics
+    registry + /metrics endpoint, span tracing, flight recorder.  All
+    host-side — tpulint TPU007 keeps obs out of traced modules, so none
+    of these knobs can change a compiled program."""
+
+    # Master switch for the DURABLE surfaces (journal/spans/flight files
+    # under <workdir>/<name>/obs).  Off, events still derive their log
+    # lines and feed the in-memory flight ring — zero filesystem traffic.
+    enabled: bool = False
+    # Override the artifact directory ("" = <workdir>/<name>/obs).
+    dir: str = ""
+    # /metrics + /healthz + /statusz HTTP port: -1 = no endpoint,
+    # 0 = ephemeral (logged + readable via obs.metrics_port()).
+    metrics_port: int = -1
+    # Per-step train spans + per-request serving spans -> spans.jsonl
+    # (Chrome-trace lines; tools/obs_report.py wraps them loadable).
+    spans: bool = True
+    # Flight-recorder ring size (most-recent events+spans kept for the
+    # postmortem dump).
+    flight_size: int = 512
+    # Seconds between metrics_flush journal events (0 = only at close).
+    flush_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class Config:
     name: str = "faster_rcnn_r50_fpn_coco"
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     workdir: str = "runs"
 
 

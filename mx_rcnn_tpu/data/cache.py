@@ -43,6 +43,8 @@ from typing import Optional
 
 import numpy as np
 
+from mx_rcnn_tpu import obs
+
 log = logging.getLogger("mx_rcnn_tpu")
 
 # -- quarantine journal -------------------------------------------------------
@@ -288,10 +290,13 @@ class TensorCache:
         if reason not in ("cache_checksum", "cache_truncated"):
             reason = "cache_checksum"
         path = self._path(key)
-        log.error(
-            "tensor cache: corrupt blob for image %r (%s) at %s; "
-            "quarantined + rebuilding from source", image_id, error, path,
-        )
+        obs.emit("data", "cache_quarantine", {
+            "image_id": image_id, "error": str(error), "path": path,
+            "reason": reason,
+        }, logger=log)
+        obs.counter(
+            "cache_quarantines_total", "corrupt tensor blobs quarantined"
+        ).inc(reason=reason)
         if self.quarantine_path:
             quarantine_append(self.quarantine_path, {
                 "image_id": image_id,

@@ -60,6 +60,8 @@ import sys
 import time
 from typing import Callable, Iterator, Optional
 
+from mx_rcnn_tpu import obs
+
 log = logging.getLogger("mx_rcnn_tpu")
 
 # Watchdog staleness threshold override (seconds, float) — chaos scenarios
@@ -418,10 +420,10 @@ class InputService:
             if alive and not stale:
                 continue
             if alive:
-                log.warning(
-                    "%s: worker %d wedged (no heartbeat for %.1fs); killing",
-                    self._name, wid, now - (hb or slot.spawned_at),
-                )
+                obs.emit("data", "worker_wedged", {
+                    "service": self._name, "worker": wid,
+                    "heartbeat_age_s": now - (hb or slot.spawned_at),
+                }, logger=log)
                 slot.proc.kill()
                 slot.proc.join(timeout=5.0)
                 why = "wedged"
@@ -455,20 +457,25 @@ class InputService:
             heapq.heappush(self._pending, idx)
         self.reassigned += len(lost)
         self._discard_queues(slot)
+        obs.counter(
+            "data_worker_deaths_total", "decode worker deaths/wedges"
+        ).inc(service=self._name)
+        obs.counter(
+            "data_batches_reassigned_total",
+            "in-flight batches returned to the pending heap",
+        ).inc(len(lost), service=self._name)
         if slot.respawns_left > 0:
-            log.warning(
-                "%s: worker %d %s; reassigning %d in-flight batch(es) %s; "
-                "respawning (%d respawn(s) left)",
-                self._name, wid, why, len(lost), lost,
-                slot.respawns_left - 1,
-            )
+            obs.emit("data", "worker_death", {
+                "service": self._name, "worker": wid, "why": why,
+                "lost": len(lost), "indices": lost,
+                "respawns_left": slot.respawns_left - 1,
+            }, logger=log)
             self._slots[wid] = self._spawn(wid, slot.respawns_left - 1)
         else:
-            log.error(
-                "%s: worker %d %s; respawn budget exhausted — slot retired "
-                "(%d in-flight batch(es) reassigned)",
-                self._name, wid, why, len(lost),
-            )
+            obs.emit("data", "worker_retired", {
+                "service": self._name, "worker": wid, "why": why,
+                "lost": len(lost), "indices": lost,
+            }, logger=log)
             self._slots[wid] = None
 
     def _go_dead(self) -> None:
@@ -479,11 +486,9 @@ class InputService:
                 f"{self._name}: all workers dead and respawn budget "
                 f"exhausted after {self.deaths} death(s)"
             )
-        log.error(
-            "%s: all workers dead, respawn budget exhausted (%d deaths); "
-            "falling back to in-process synchronous assembly — the run "
-            "continues degraded", self._name, self.deaths,
-        )
+        obs.emit("data", "service_fallback", {
+            "service": self._name, "deaths": self.deaths,
+        }, logger=log)
         self._mode = "sync"
 
     # -- degraded mode -----------------------------------------------------

@@ -1,0 +1,201 @@
+"""Typed event schema: one table of event kinds -> (level, log line).
+
+Satellite contract ("one source of truth"): the critical-path log lines
+that the chaos harness and operators grep for are DERIVED from the typed
+event payload here, not hand-formatted at the call site.  A call site
+does::
+
+    obs.emit("data", "worker_death", {"service": name, "worker": wid,
+                                      "why": why, ...}, logger=log)
+
+and gets (a) a journal record, (b) a flight-recorder ring entry, and
+(c) the exact log line the harness asserts on (e.g. the literal
+``"respawning"`` / ``"falling back to in-process synchronous assembly"``
+substrings in ``tools/chaos.py``).  Changing a line here changes it
+everywhere — and the typed payload survives even if the prose drifts.
+
+Unknown kinds are legal (the plane is open-vocabulary): they render as
+``"<subsystem>: <kind> <payload>"`` at INFO.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+__all__ = ["EVENTS", "render"]
+
+
+def _fmt_worker_death(p: dict) -> str:
+    return (
+        "{service}: worker {worker} {why}; reassigning {lost} in-flight "
+        "batch(es) {indices}; respawning ({respawns_left} respawn(s) left)"
+    ).format(**p)
+
+
+def _fmt_worker_retired(p: dict) -> str:
+    return (
+        "{service}: worker {worker} {why}; respawn budget exhausted — "
+        "slot retired ({lost} in-flight batch(es) reassigned)"
+    ).format(**p)
+
+
+def _fmt_worker_wedged(p: dict) -> str:
+    return (
+        "{service}: worker {worker} wedged (no heartbeat for "
+        "{heartbeat_age_s:.1f}s); killing"
+    ).format(**p)
+
+
+def _fmt_service_fallback(p: dict) -> str:
+    return (
+        "{service}: all workers dead, respawn budget exhausted "
+        "({deaths} deaths); falling back to in-process synchronous "
+        "assembly — the run continues degraded"
+    ).format(**p)
+
+
+def _fmt_cache_quarantine(p: dict) -> str:
+    return (
+        "tensor cache: corrupt blob for image {image_id!r} ({error}) at "
+        "{path}; quarantined + rebuilding from source"
+    ).format(**p)
+
+
+def _fmt_guardian_rollback(p: dict) -> str:
+    return (
+        "guardian: {reason} at step {step} — rolling back to the last "
+        "good checkpoint and skipping the offending data window "
+        "(attempt {attempt}/{max_attempts})"
+    ).format(**p)
+
+
+def _fmt_rollback_restored(p: dict) -> str:
+    return (
+        "guardian rollback: restored step {restored_step}, skipping "
+        "{skipped} batch(es) of the data schedule (total skipped: "
+        "{total_skipped})"
+    ).format(**p)
+
+
+def _fmt_loss_spike(p: dict) -> str:
+    return (
+        "guardian: loss spike at step {step} — {loss:.4f} is "
+        "{sigma:.1f} sigma above the trailing-window mean {mean:.4f} "
+        "(watching for divergence)"
+    ).format(**p)
+
+
+def _fmt_fleet_quarantine(p: dict) -> str:
+    return "fleet: quarantining replica {replica}: {reason}".format(**p)
+
+
+def _fmt_fleet_reinstate(p: dict) -> str:
+    return "fleet: replica {replica} reinstated".format(**p)
+
+
+def _fmt_fleet_retire(p: dict) -> str:
+    return (
+        "fleet: replica {replica} exhausted its rebuild budget "
+        "({rebuilds}); retiring it"
+    ).format(**p)
+
+
+def _fmt_weight_swap(p: dict) -> str:
+    return (
+        "fleet: weight swap -> generation {generation} "
+        "({replicas} replica(s) rolled)"
+    ).format(**p)
+
+
+def _fmt_engine_dead(p: dict) -> str:
+    return (
+        "watchdog: {reason} — failing {queued} queued request(s)"
+    ).format(**p)
+
+
+def _fmt_engine_killed(p: dict) -> str:
+    return "engine killed: {reason}".format(**p)
+
+
+def _fmt_shed(p: dict) -> str:
+    return (
+        "shed: queue full ({queue_depth}/{max_queue}), request rejected"
+    ).format(**p)
+
+
+def _fmt_breaker(p: dict) -> str:
+    return (
+        "circuit breaker {level}: {old_state} -> {new_state}"
+    ).format(**p)
+
+
+def _fmt_ladder(p: dict) -> str:
+    return (
+        "degradation ladder: level {old_level} -> {new_level}"
+    ).format(**p)
+
+
+def _fmt_ckpt_saved(p: dict) -> str:
+    return "checkpoint saved at step {step}".format(**p)
+
+
+def _fmt_ckpt_restored(p: dict) -> str:
+    return "checkpoint restored at step {step}".format(**p)
+
+
+def _fmt_preempt(p: dict) -> str:
+    return (
+        "preemption drain at step {step}: emergency checkpoint written, "
+        "exiting resumable"
+    ).format(**p)
+
+
+def _fmt_metrics_flush(p: dict) -> str:
+    return "metrics flush ({metrics} series)".format(
+        metrics=len(p.get("snapshot", {}))
+    )
+
+
+# kind -> (logging level, payload -> line).  Level is the default; emit()
+# callers cannot override the line, only the destination logger.
+EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
+    # data service / cache
+    "worker_death": (logging.WARNING, _fmt_worker_death),
+    "worker_retired": (logging.ERROR, _fmt_worker_retired),
+    "worker_wedged": (logging.WARNING, _fmt_worker_wedged),
+    "service_fallback": (logging.ERROR, _fmt_service_fallback),
+    "cache_quarantine": (logging.ERROR, _fmt_cache_quarantine),
+    # train loop / guardian
+    "guardian_rollback": (logging.ERROR, _fmt_guardian_rollback),
+    "rollback_restored": (logging.WARNING, _fmt_rollback_restored),
+    "guardian_loss_spike": (logging.WARNING, _fmt_loss_spike),
+    "checkpoint_saved": (logging.INFO, _fmt_ckpt_saved),
+    "checkpoint_restored": (logging.INFO, _fmt_ckpt_restored),
+    "preempt_drain": (logging.WARNING, _fmt_preempt),
+    # serving engine / fleet
+    "engine_dead": (logging.ERROR, _fmt_engine_dead),
+    "engine_killed": (logging.WARNING, _fmt_engine_killed),
+    "shed": (logging.DEBUG, _fmt_shed),
+    "breaker_transition": (logging.INFO, _fmt_breaker),
+    "ladder_transition": (logging.INFO, _fmt_ladder),
+    "fleet_quarantine": (logging.WARNING, _fmt_fleet_quarantine),
+    "fleet_reinstate": (logging.INFO, _fmt_fleet_reinstate),
+    "fleet_retire": (logging.ERROR, _fmt_fleet_retire),
+    "weight_swap": (logging.INFO, _fmt_weight_swap),
+    # plane-internal
+    "metrics_flush": (logging.DEBUG, _fmt_metrics_flush),
+}
+
+
+def render(subsystem: str, kind: str, payload: dict) -> tuple[int, str]:
+    """(level, derived log line) for an event; open-vocabulary fallback."""
+    entry = EVENTS.get(kind)
+    if entry is None:
+        return logging.INFO, f"{subsystem}: {kind} {payload}"
+    level, fmt = entry
+    try:
+        return level, fmt(payload)
+    except (KeyError, ValueError, IndexError) as e:
+        # A malformed payload must never take down the emitting subsystem.
+        return level, f"{subsystem}: {kind} {payload} (template error: {e})"

@@ -1,0 +1,258 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+Stdlib-only and host-side by construction (tpulint TPU007 keeps it out of
+traced modules).  Everything is thread-safe: hot paths touch one
+``threading.Lock`` per metric family and do integer/float arithmetic —
+no allocation beyond the first observation of a label set.
+
+Rendering follows the Prometheus text exposition format 0.0.4, so the
+``/metrics`` endpoint (obs/endpoint.py) can be scraped by a stock
+Prometheus server; :meth:`Registry.snapshot` produces the same data as a
+JSON-able dict for the periodic journal flush (headless runs keep the
+numbers even with no scraper attached).
+
+Histograms use FIXED buckets chosen at creation: cumulative bucket
+counts + ``_sum``/``_count``, which is exactly what p50/p99 recording
+rules need.  The default buckets cover serving latencies from 1 ms to
+60 s.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional, Sequence
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "DEFAULT_LATENCY_BUCKETS_S",
+]
+
+# 1ms .. 60s, roughly log-spaced: serving device calls sit mid-range,
+# queue waits at the bottom, rebuild-shadowed tails at the top.
+DEFAULT_LATENCY_BUCKETS_S: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Shared name/help/label-children plumbing for one metric family."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def _header(self) -> list[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        return out
+
+
+class Counter(_Metric):
+    """Monotonic counter, optionally labelled via ``inc(**labels)``."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = self._header()
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_label_str(key)} {v:g}")
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {_label_str(k) or "": v for k, v in self._values.items()}
+
+
+class Gauge(_Metric):
+    """Settable point-in-time value (queue depth, worker count, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        super().__init__(name, help)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = self._header()
+        for key, v in items or [((), 0.0)]:
+            out.append(f"{self.name}{_label_str(key)} {v:g}")
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {_label_str(k) or "": v for k, v in self._values.items()}
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (cumulative counts + sum/count per labels)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help: str = "",
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+    ) -> None:
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one finite bucket")
+        # per label-key: ([per-bucket counts...], count, sum)
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * len(self.buckets), 0, 0.0]
+            counts, _, _ = s
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            s[1] += 1
+            s[2] += value
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        """Bucket-upper-bound estimate of the q-quantile (0..1); None when
+        the series is empty.  Good enough for journal flushes — Prometheus
+        recording rules do the real interpolation server-side."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s[1] == 0:
+                return None
+            counts, total = list(s[0]), s[1]
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c:
+                return self.buckets[i]
+        return float("inf")
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(
+                (k, (list(s[0]), s[1], s[2]))
+                for k, s in self._series.items()
+            )
+        out = self._header()
+        for key, (counts, count, total) in items:
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lk = _label_str(key + (("le", f"{b:g}"),))
+                out.append(f"{self.name}_bucket{lk} {cum}")
+            lk = _label_str(key + (("le", "+Inf"),))
+            out.append(f"{self.name}_bucket{lk} {count}")
+            out.append(f"{self.name}_sum{_label_str(key)} {total:g}")
+            out.append(f"{self.name}_count{_label_str(key)} {count}")
+        return out
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            items = list(self._series.items())
+        for key, (counts, count, total) in items:
+            out[_label_str(key) or ""] = {
+                "count": count,
+                "sum": total,
+                "p50": self.percentile(0.50, **dict(key)),
+                "p99": self.percentile(0.99, **dict(key)),
+            }
+        return out
+
+
+class Registry:
+    """Name -> metric family; idempotent getters create on first use."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+    ) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def families(self) -> Iterable[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """Prometheus text exposition (0.0.4) of every family."""
+        lines: list[str] = []
+        for m in sorted(self.families(), key=lambda m: m.name):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able {name: {labelstr: value|hist-summary}} for the
+        periodic journal flush."""
+        return {m.name: m.snapshot() for m in self.families()}

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Callable, Mapping, Optional, Sequence
 
+from mx_rcnn_tpu import obs
+
 # Quality-ordered serving levels, best first.  ``small`` reuses the FULL
 # program of a smaller resolution bucket; ``full_q8``, ``reduced`` and
 # ``proposals`` are distinct compiled programs (engine warmup compiles
@@ -100,22 +102,36 @@ class CircuitBreaker:
             self._probing = False
 
     def record_success(self) -> None:
+        closed_from: Optional[str] = None
         with self._lock:
             self._consecutive = 0
             if self._opened_at is not None:
                 # A success while open can only be the half-open probe.
+                closed_from = self._state_locked()
                 self._opened_at = None
             self._probing = False
+        if closed_from is not None:
+            obs.emit("serve", "breaker_transition", {
+                "level": "full", "old_state": closed_from,
+                "new_state": "closed",
+            })
 
     def record_failure(self) -> None:
+        opened_from: Optional[str] = None
         with self._lock:
             self._consecutive += 1
             if self._probing or self._consecutive >= self.failure_threshold:
                 if self._opened_at is None or self._probing:
                     self.trips += 1
+                    opened_from = self._state_locked()
                 self._opened_at = self._clock()
                 self._consecutive = 0
                 self._probing = False
+        if opened_from is not None:
+            obs.emit("serve", "breaker_transition", {
+                "level": "full", "old_state": opened_from,
+                "new_state": "open",
+            })
 
 
 def plan_level(
@@ -209,27 +225,37 @@ class HysteresisPlanner:
             remaining, estimates, full_allowed, available,
             headroom=self.headroom,
         )
-        with self._lock:
-            current = self._level
-            if current is None or current not in available:
-                self._level, self._streak = target, 0
-                return target
-            if LEVELS.index(target) >= LEVELS.index(current):
-                # Same or worse quality: follow plan_level immediately.
-                self._level, self._streak = target, 0
-                return target
-            # Upgrade candidate: count margin-clean plans before moving.
-            est = estimates.get(target)
-            comfortable = (
-                remaining is None
-                or est is None
-                or est * self.headroom * self.up_margin <= remaining
-            )
-            self._streak = self._streak + 1 if comfortable else 0
-            if self._streak >= self.up_dwell:
-                self._level, self._streak = target, 0
-                return target
-            return current
+        moved: Optional[tuple[str, str]] = None
+        try:
+            with self._lock:
+                current = self._level
+                if current is None or current not in available:
+                    self._level, self._streak = target, 0
+                    return target
+                if LEVELS.index(target) >= LEVELS.index(current):
+                    # Same or worse quality: follow plan_level immediately.
+                    self._level, self._streak = target, 0
+                    if target != current:
+                        moved = (current, target)
+                    return target
+                # Upgrade candidate: count margin-clean plans before moving.
+                est = estimates.get(target)
+                comfortable = (
+                    remaining is None
+                    or est is None
+                    or est * self.headroom * self.up_margin <= remaining
+                )
+                self._streak = self._streak + 1 if comfortable else 0
+                if self._streak >= self.up_dwell:
+                    self._level, self._streak = target, 0
+                    moved = (current, target)
+                    return target
+                return current
+        finally:
+            if moved is not None:
+                obs.emit("serve", "ladder_transition", {
+                    "old_level": moved[0], "new_level": moved[1],
+                })
 
 
 class LatencyEstimator:

@@ -40,6 +40,7 @@ from typing import Callable, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.serve import health as health_mod
 from mx_rcnn_tpu.serve.degrade import (
     FULL_QUALITY_LEVELS,
@@ -77,7 +78,8 @@ class InferenceRequest:
     """A submitted request; ``result()`` blocks until served or failed."""
 
     __slots__ = ("image", "enqueued_at", "deadline", "_event", "_result",
-                 "_error", "plan", "_callbacks", "_cb_lock")
+                 "_error", "plan", "_callbacks", "_cb_lock",
+                 "trace_id", "span", "queue_span")
 
     def __init__(self, image: np.ndarray, enqueued_at: float,
                  deadline: Optional[float]) -> None:
@@ -90,6 +92,13 @@ class InferenceRequest:
         self.plan: Optional[Plan] = None
         self._callbacks: list[Callable[["InferenceRequest"], None]] = []
         self._cb_lock = threading.Lock()
+        # Tracing state (obs/tracing.py): set by submit() when span
+        # recording is on; _finish() closes whatever is still open so
+        # every completion path — served, shed, deadline, engine death —
+        # ends the request's span tree exactly once.
+        self.trace_id: Optional[str] = None
+        self.span = None
+        self.queue_span = None
 
     def _set_result(self, result: dict) -> None:
         self._result = result
@@ -100,6 +109,12 @@ class InferenceRequest:
         self._finish()
 
     def _finish(self) -> None:
+        if self.queue_span is not None:
+            self.queue_span.end()
+        if self.span is not None:
+            if self._error is not None:
+                self.span.set(error=type(self._error).__name__)
+            self.span.end()
         self._event.set()
         with self._cb_lock:
             cbs, self._callbacks = self._callbacks, []
@@ -528,6 +543,9 @@ class InferenceEngine:
             headroom=headroom, up_margin=up_margin, up_dwell=up_dwell
         )
         self.replica_id = replica_id
+        self._mlabels = {
+            "replica": "-" if replica_id is None else str(replica_id)
+        }
         self.health = health_mod.EngineHealth(
             clock=clock, replica_id=replica_id
         )
@@ -606,6 +624,10 @@ class InferenceEngine:
         fence a quarantined replica (waiters fail fast and retry on a
         healthy one); chaos scenarios use it as the crash injection."""
         self.health.transition(health_mod.DEAD, reason)
+        obs.emit("serve", "engine_killed", {"reason": reason}, logger=log)
+        obs.flight_dump(
+            "engine_killed", {"replica": self.replica_id, "reason": reason}
+        )
         error = EngineUnavailable(f"engine died: {reason}")
         with self._lock:
             stuck = list(self._inflight_reqs)
@@ -632,11 +654,15 @@ class InferenceEngine:
     # -- client API --------------------------------------------------------
 
     def submit(
-        self, image: np.ndarray, timeout: Optional[float] = None
+        self, image: np.ndarray, timeout: Optional[float] = None,
+        trace_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
     ) -> InferenceRequest:
         """Enqueue one image; returns immediately.  Raises
         :class:`Overloaded` when the queue is full, or
-        :class:`EngineUnavailable` when the engine cannot serve."""
+        :class:`EngineUnavailable` when the engine cannot serve.
+        ``trace_id``/``parent_span_id`` link the request's spans under a
+        caller's trace (the fleet router passes its attempt span)."""
         if not self._started:
             raise EngineUnavailable("engine not started")
         if self._draining or self._stopping:
@@ -650,14 +676,39 @@ class InferenceEngine:
         req = InferenceRequest(
             image, now, None if timeout is None else now + timeout
         )
+        req.trace_id = trace_id
+        if obs.spans_enabled():
+            req.span = obs.span(
+                "engine_request", subsystem="serve", trace_id=trace_id,
+                parent_id=parent_span_id, attrs=dict(self._mlabels),
+            )
+            req.trace_id = req.span.trace_id
+            req.queue_span = req.span.child("queue")
         try:
             self._queue.put_nowait(req)
         except queue_mod.Full:
             self.health.record_shed()
             self._note_pressure()
+            obs.counter(
+                "serve_shed_total", "requests shed by admission control"
+            ).inc(**self._mlabels)
+            obs.emit("serve", "shed", {
+                "queue_depth": self._queue.qsize(),
+                "max_queue": self._queue.maxsize,
+            }, logger=log)
+            if req.queue_span is not None:
+                req.queue_span.end()
+            if req.span is not None:
+                req.span.end(error="Overloaded")
             raise Overloaded(
                 f"queue full ({self._queue.maxsize} waiting); request shed"
             ) from None
+        obs.counter(
+            "serve_requests_total", "requests admitted"
+        ).inc(**self._mlabels)
+        obs.gauge(
+            "serve_queue_depth", "accepted-but-unserved requests"
+        ).set(self._queue.qsize(), **self._mlabels)
         return req
 
     def infer(
@@ -748,6 +799,8 @@ class InferenceEngine:
                 )
                 continue
             first.plan = self._plan(first)
+            if first.queue_span is not None:
+                first.queue_span.end(level=first.plan.level)
             batch = [first]
             while len(batch) < self.runner.batch_size:
                 try:
@@ -770,6 +823,8 @@ class InferenceEngine:
                     )
                     continue
                 nxt.plan = self._plan(nxt)
+                if nxt.queue_span is not None:
+                    nxt.queue_span.end(level=nxt.plan.level)
                 if nxt.plan[1:] != first.plan[1:]:
                     self._carry = nxt  # different program; runs next
                     break
@@ -790,6 +845,12 @@ class InferenceEngine:
                 self._inflight_since = start
                 self._inflight_plan = plan
                 self._inflight_reqs = list(batch)
+            dspan = None
+            if batch[0].span is not None:
+                dspan = batch[0].span.child("device", attrs={
+                    "level": plan.level, "bucket": list(plan.bucket),
+                    "batch": len(batch),
+                })
             try:
                 results = self.runner.run(
                     plan.mode, plan.bucket, [r.image for r in batch]
@@ -798,6 +859,10 @@ class InferenceEngine:
             except BaseException as e:  # noqa: BLE001 - typed below
                 results, err = None, e
             finally:
+                if dspan is not None:
+                    if err is not None:
+                        dspan.set(error=type(err).__name__)
+                    dspan.end()
                 with self._lock:
                     self._inflight_since = None
                     self._inflight_plan = None
@@ -844,6 +909,10 @@ class InferenceEngine:
                     )
                 else:
                     self.health.record_served(plan.level, latency)
+                    obs.histogram(
+                        "serve_request_latency_seconds",
+                        "served request latency (device call to result)",
+                    ).observe(latency, level=plan.level, **self._mlabels)
                     res = dict(res)
                     res["level"] = plan.level
                     res["latency_s"] = latency
@@ -893,9 +962,13 @@ class InferenceEngine:
                 f"device call hung for {age:.1f}s "
                 f"(plan={plan}, hang_timeout={self.hang_timeout}s)",
             )
-            log.error(
-                "watchdog: %s — failing %d queued request(s)",
-                self.health.reason, self._queue.qsize(),
+            obs.emit("serve", "engine_dead", {
+                "reason": self.health.reason,
+                "queued": self._queue.qsize(),
+            }, logger=log)
+            obs.flight_dump(
+                "engine_dead",
+                {"replica": self.replica_id, "reason": self.health.reason},
             )
             error = EngineUnavailable(f"engine died: {self.health.reason}")
             with self._lock:

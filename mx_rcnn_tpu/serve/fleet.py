@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Callable, Optional, Sequence, Union
 
+from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.serve.engine import (
     DeadlineExceeded,
     EngineUnavailable,
@@ -81,6 +82,10 @@ class FleetRequest:
         # Watcher-thread-private bookkeeping (single writer):
         self._retries = 0
         self._hedged = False
+        # Tracing (obs/tracing.py): the root request span; every attempt
+        # span (and the engine spans under it) shares trace_id.
+        self.trace_id: Optional[str] = None
+        self.span = None
 
     def _latch_result(self, result: dict) -> bool:
         with self._lock:
@@ -88,7 +93,9 @@ class FleetRequest:
                 return False
             self._result = result
             self._event.set()
-            return True
+        if self.span is not None:
+            self.span.end(outcome="ok")
+        return True
 
     def _latch_error(self, error: BaseException) -> bool:
         with self._lock:
@@ -96,7 +103,9 @@ class FleetRequest:
                 return False
             self._error = error
             self._event.set()
-            return True
+        if self.span is not None:
+            self.span.end(error=type(error).__name__)
+        return True
 
     def tried_rids(self) -> frozenset[int]:
         with self._lock:
@@ -120,13 +129,14 @@ class FleetRequest:
 class _Attempt:
     """One replica submission of a fleet request."""
 
-    __slots__ = ("rid", "sub", "is_hedge", "handled")
+    __slots__ = ("rid", "sub", "is_hedge", "handled", "span")
 
     def __init__(self, rid: int, sub, is_hedge: bool) -> None:
         self.rid = rid
         self.sub = sub
         self.is_hedge = is_hedge
         self.handled = False  # watcher-private: failure already processed
+        self.span = None      # attempt span (child of the request span)
 
 
 class _Replica:
@@ -274,10 +284,14 @@ class FleetRouter:
 
     # -- client API --------------------------------------------------------
 
-    def submit(self, image, timeout: Optional[float] = None) -> FleetRequest:
+    def submit(self, image, timeout: Optional[float] = None,
+               trace_id: Optional[str] = None) -> FleetRequest:
         """Route one image; returns immediately.  Raises
         :class:`Overloaded` when every routable replica shed it, or
-        :class:`EngineUnavailable` when no replica can serve."""
+        :class:`EngineUnavailable` when no replica can serve.
+        ``trace_id`` stamps the request's span tree (loadgen passes one
+        per synthetic request); one is minted when spans are recording
+        and none was given."""
         if not self._started:
             raise EngineUnavailable("fleet not started")
         if self._draining or self._stopped:
@@ -287,6 +301,12 @@ class FleetRouter:
         freq = FleetRequest(
             image, now, None if timeout is None else now + timeout
         )
+        freq.trace_id = trace_id
+        if obs.spans_enabled():
+            freq.span = obs.span(
+                "request", subsystem="fleet", trace_id=trace_id
+            )
+            freq.trace_id = freq.span.trace_id
         freq.bucket = self._bucket_for(image)
         try:
             self._place(freq, is_hedge=False)
@@ -294,11 +314,15 @@ class FleetRouter:
             with self._lock:
                 self._submitted += 1
                 self._shed += 1
+            if freq.span is not None:
+                freq.span.end(error="Overloaded")
             raise
-        except ServeError:
+        except ServeError as e:
             with self._lock:
                 self._submitted += 1
                 self._failed += 1
+            if freq.span is not None:
+                freq.span.end(error=type(e).__name__)
             raise
         with self._lock:
             self._submitted += 1
@@ -335,6 +359,9 @@ class FleetRouter:
                         "fleet: weight swap failed on replica %d", r.rid
                     )
                     self._quarantine(r, f"swap failed: {e}")
+            obs.emit("serve", "weight_swap", {
+                "generation": target, "replicas": len(live),
+            }, logger=log)
             return target
 
     def kill_replica(self, rid: int, reason: str = "operator kill") -> None:
@@ -456,21 +483,43 @@ class FleetRouter:
             if eng is None:
                 exclude.add(view.rid)
                 continue
+            aspan = None
+            if freq.span is not None:
+                aspan = freq.span.child("attempt", attrs={
+                    "replica": view.rid, "hedge": is_hedge,
+                    "retry": freq._retries,
+                })
             try:
-                sub = eng.submit(freq.image, timeout=remaining)
+                if aspan is None:
+                    sub = eng.submit(freq.image, timeout=remaining)
+                else:
+                    sub = eng.submit(
+                        freq.image, timeout=remaining,
+                        trace_id=freq.trace_id,
+                        parent_span_id=aspan.span_id,
+                    )
             except Overloaded:
+                if aspan is not None:
+                    aspan.end(error="Overloaded")
                 overloaded = True
                 exclude.add(view.rid)
                 continue
             except EngineUnavailable:
                 # Raced the replica dying; the supervisor will fence it.
+                if aspan is not None:
+                    aspan.end(error="EngineUnavailable")
                 exclude.add(view.rid)
                 continue
             att = _Attempt(view.rid, sub, is_hedge)
+            att.span = aspan
             with self._lock:
                 r.inflight += 1
                 if is_hedge:
                     self._hedges += 1
+            if is_hedge:
+                obs.counter(
+                    "fleet_hedges_total", "duplicate hedge attempts"
+                ).inc()
             with freq._lock:
                 freq._attempts.append(att)
             sub.add_done_callback(
@@ -498,6 +547,13 @@ class FleetRouter:
                         self._completed += 1
                         if att.is_hedge:
                             self._hedge_wins += 1
+        # Span I/O after the latch: a file write between sub completion
+        # and latching would widen the window in which the watcher sees
+        # a done-but-unlatched attempt.
+        if att.span is not None:
+            if err is not None:
+                att.span.set(error=type(err).__name__)
+            att.span.end()
         freq._wake.set()
 
     # -- per-request watcher ----------------------------------------------
@@ -544,7 +600,14 @@ class FleetRouter:
                 now = self._clock()
                 with freq._lock:
                     attempts = list(freq._attempts)
-                live = sum(1 for a in attempts if not a.sub.done())
+                # An attempt that completed successfully but whose done
+                # callback has not latched the result yet still counts
+                # as live — latching is imminent, and declaring "no
+                # replica could serve" here would race it.
+                live = sum(
+                    1 for a in attempts
+                    if not a.sub.done() or a.sub.error() is None
+                )
                 last_err: Optional[BaseException] = None
                 for a in attempts:
                     if a.handled or not a.sub.done():
@@ -560,6 +623,9 @@ class FleetRouter:
                         freq._retries += 1
                         with self._lock:
                             self._retries_total += 1
+                        obs.counter(
+                            "fleet_retries_total", "failed-attempt retries"
+                        ).inc()
                         try:
                             self._place(freq, is_hedge=False)
                             live += 1
@@ -616,7 +682,12 @@ class FleetRouter:
                 return
             r.state = QUARANTINED
             self._quarantines += 1
-        log.warning("fleet: quarantining replica %d: %s", r.rid, reason)
+        obs.emit("serve", "fleet_quarantine", {
+            "replica": r.rid, "reason": reason,
+        }, logger=log)
+        obs.counter(
+            "fleet_quarantines_total", "replica quarantines"
+        ).inc()
         if r.engine is not None:
             try:
                 # Fence: queued work fails fast with a typed error and
@@ -646,9 +717,11 @@ class FleetRouter:
                         with self._lock:
                             if r.state == QUARANTINED:
                                 r.state = DEAD
-                        log.error(
-                            "fleet: replica %d exhausted its rebuild "
-                            "budget (%d); retiring it", r.rid, rebuilds,
+                        obs.emit("serve", "fleet_retire", {
+                            "replica": r.rid, "rebuilds": rebuilds,
+                        }, logger=log)
+                        obs.flight_dump(
+                            "fleet_retire", {"replica": r.rid}
                         )
                         continue
                     with self._lock:
@@ -686,7 +759,13 @@ class FleetRouter:
             if eng is not None:
                 eng.stop(drain=False)
             else:
-                log.info("fleet: replica %d reinstated", r.rid)
+                obs.emit(
+                    "serve", "fleet_reinstate", {"replica": r.rid},
+                    logger=log,
+                )
+                obs.counter(
+                    "fleet_reinstatements_total", "replica reinstatements"
+                ).inc()
         except Exception:
             log.exception("fleet: rebuild of replica %d failed", r.rid)
         finally:

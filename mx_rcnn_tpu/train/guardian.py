@@ -35,6 +35,8 @@ import math
 from dataclasses import dataclass
 from typing import Optional
 
+from mx_rcnn_tpu import obs
+
 log = logging.getLogger("mx_rcnn_tpu")
 
 
@@ -105,6 +107,11 @@ class Guardian:
         if reason is not None:
             self.rollbacks += 1
             if self.rollbacks > self.max_rollbacks:
+                obs.emit("train", "training_diverged", {
+                    "step": step, "reason": reason,
+                    "rollbacks": self.max_rollbacks,
+                }, logger=log)
+                obs.flight_dump("training_diverged")
                 raise TrainingDiverged(
                     f"non-finite training metrics at step {step} ({reason}) "
                     f"after {self.max_rollbacks} rollback retr"
@@ -112,12 +119,11 @@ class Guardian:
                     "the divergence is not data-local; lower the lr or "
                     "inspect the model"
                 )
-            log.error(
-                "guardian: %s at step %d — rolling back to the last good "
-                "checkpoint and skipping the offending data window "
-                "(attempt %d/%d)", reason, step, self.rollbacks,
-                self.max_rollbacks,
-            )
+            obs.emit("train", "guardian_rollback", {
+                "step": step, "reason": reason,
+                "attempt": self.rollbacks,
+                "max_attempts": self.max_rollbacks,
+            }, logger=log)
             return Rollback(step, reason, self.rollbacks)
         self._note_loss(step, means)
         return None
@@ -134,9 +140,8 @@ class Guardian:
             var = sum((x - mean) ** 2 for x in self._losses) / n
             std = math.sqrt(var)
             if std > 0.0 and (loss - mean) / std > self.spike_zscore:
-                log.warning(
-                    "guardian: loss spike at step %d — %.4f is %.1f sigma "
-                    "above the trailing-window mean %.4f (watching for "
-                    "divergence)", step, loss, (loss - mean) / std, mean,
-                )
+                obs.emit("train", "guardian_loss_spike", {
+                    "step": step, "loss": float(loss),
+                    "sigma": (loss - mean) / std, "mean": mean,
+                }, logger=log)
         self._losses.append(float(loss))

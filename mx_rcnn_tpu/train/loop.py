@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.data import DetectionLoader, build_dataset, filter_roidb
 from mx_rcnn_tpu.detection import TwoStageDetector
@@ -309,6 +310,22 @@ def train(
     :class:`~mx_rcnn_tpu.train.preemption.Preempted` (the CLIs map it to
     the resumable exit code); non-finite metrics trigger the guardian's
     bounded rollback-and-skip, then :class:`TrainingDiverged`."""
+    if cfg.obs.enabled and jax.process_index() == 0:
+        # Durable observability (docs/observability.md): journal + spans
+        # + flight dumps under the run directory (or cfg.obs.dir), plus
+        # the optional /metrics endpoint.  Idempotent — a caller that
+        # configured the plane itself keeps its setup only if it also
+        # left cfg.obs.enabled off.
+        obs.configure(
+            cfg.obs.dir or f"{workdir or cfg.workdir}/{cfg.name}/obs",
+            metrics_port=(
+                cfg.obs.metrics_port if cfg.obs.metrics_port >= 0 else None
+            ),
+            spans=cfg.obs.spans,
+            flight_size=cfg.obs.flight_size,
+            flush_s=cfg.obs.flush_s,
+        )
+        obs.install_crash_handler()
     if mesh is None and jax.device_count() > 1:
         mesh = make_mesh(model_parallel=cfg.train.spatial_partition)
     model, tx, fresh_state, step_fn, global_batch = build_all(
@@ -345,6 +362,10 @@ def train(
         state = restore_checkpoint(
             ckpt_dir, state, validate=finite_state,
             shardings=plan.state_shardings(state),
+        )
+        obs.emit(
+            "train", "checkpoint_restored", {"step": int(state.step)},
+            logger=log,
         )
         log.info("resumed from %s at step %d", ckpt_dir, int(state.step))
         _warn_config_drift(
@@ -449,6 +470,10 @@ def train(
     # instead of aborting the run.
     if workdir and latest_step(ckpt_dir) is None:
         save_checkpoint(ckpt_dir, jax.device_get(state))
+        obs.emit(
+            "train", "checkpoint_saved", {"step": int(state.step)},
+            logger=log,
+        )
     # Quantize the profile window to the loop stride so it still opens
     # when i advances k at a time.  Round UP: the default (10, 15) window
     # exists to skip the compile step, so the start must never be pulled
@@ -492,9 +517,24 @@ def train(
                 else contextlib.nullcontext()
             )
             first_call = False
+            tspan = (
+                obs.span("train_step", subsystem="train", attrs={"step": i})
+                if obs.spans_enabled() else None
+            )
             with guard:
-                batch = next(it)
-                state, metrics = step_fn(state, batch)
+                if tspan is None:
+                    batch = next(it)
+                    state, metrics = step_fn(state, batch)
+                else:
+                    # Span boundaries mirror stage_bench: "data" is the
+                    # host wait past the prefetch buffer (h2d included),
+                    # "step" is the async dispatch of the device program.
+                    with tspan.child("data"):
+                        batch = next(it)
+                    with tspan.child("step"):
+                        state, metrics = step_fn(state, batch)
+            if tspan is not None:
+                tspan.end()
             pending.append(metrics)
             done = i + k
             at_log = done % cfg.train.log_every < k or i == start
@@ -537,11 +577,11 @@ def train(
                         writer.truncate(restored)
                     speedo = Speedometer(global_batch)
                     last_drain = restored
-                    log.warning(
-                        "guardian rollback: restored step %d, skipping %d "
-                        "batch(es) of the data schedule (total skipped: %d)",
-                        restored, done - restored, data_skip,
-                    )
+                    obs.emit("train", "rollback_restored", {
+                        "restored_step": restored,
+                        "skipped": done - restored,
+                        "total_skipped": data_skip,
+                    }, logger=log)
                     i = restored
                     continue
                 last_good = done
@@ -553,15 +593,27 @@ def train(
                         writer.write(done, means)
                 if at_ckpt:
                     save_checkpoint(ckpt_dir, jax.device_get(state))
+                    obs.emit(
+                        "train", "checkpoint_saved", {"step": done},
+                        logger=log,
+                    )
             if preempt.triggered:
                 # Drain complete; persist synchronously and exit resumable.
+                obs.emit(
+                    "train", "preempt_drain", {"step": done}, logger=log
+                )
                 if workdir:
                     save_checkpoint(
                         ckpt_dir, jax.device_get(state), wait=True
                     )
+                    obs.emit(
+                        "train", "checkpoint_saved", {"step": done},
+                        logger=log,
+                    )
                 if writer:
                     writer.close()
                 it.close()
+                obs.flight_dump("preempt_drain")
                 raise Preempted(done, ckpt_dir if workdir else None)
             i = done
     # Stop the host-prefetch thread (generator close -> _HostPrefetcher
@@ -572,5 +624,8 @@ def train(
         writer.close()
     if workdir:
         save_checkpoint(ckpt_dir, jax.device_get(state), wait=True)
+        obs.emit(
+            "train", "checkpoint_saved", {"step": int(steps)}, logger=log
+        )
         flush_checkpoints(ckpt_dir)
     return state

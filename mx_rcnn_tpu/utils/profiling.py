@@ -73,7 +73,9 @@ class StepTimer:
     """Wall-clock stats for loop steps, with warmup discard.
 
     Unlike the Speedometer (throughput log line), this keeps percentiles
-    for perf work: ``timer.summary()`` -> dict(mean/p50/p90 in ms).
+    for perf work: ``timer.summary()`` -> dict(mean/p50/p90/p99/max in
+    ms) — the tail columns (p99/max) are what regression tracking cares
+    about; a mean can hide a 10x straggler step.
     """
 
     def __init__(self, warmup: int = 2) -> None:
@@ -103,4 +105,6 @@ class StepTimer:
             "mean_ms": float(arr.mean()),
             "p50_ms": float(np.percentile(arr, 50)),
             "p90_ms": float(np.percentile(arr, 90)),
+            "p99_ms": float(np.percentile(arr, 99)),
+            "max_ms": float(arr.max()),
         }

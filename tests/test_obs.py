@@ -1,0 +1,513 @@
+"""Observability plane tests (docs/observability.md).
+
+The plane is a process-wide singleton with two modes; these tests prove
+the durable mode end to end — crash-safe journal semantics (torn lines,
+concurrent writers), registry thread-safety under a hammering pool, span
+parent/child integrity through a real hedged fleet request, the flight
+recorder's dump-on-crash contract against a real subprocess, and the
+acceptance story: a replica kill whose incident timeline (kill ->
+quarantine -> reinstate) ``tools/obs_report.py`` reconstructs from the
+artifacts alone.  ``tools/chaos.py`` repeats the kill against real
+subprocesses with real signals.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.obs import Journal, read_journal
+from mx_rcnn_tpu.obs import events as events_mod
+
+from test_serve import FakeRunner, _fleet, _img, _wait  # noqa: F401
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test starts and leaves the plane unconfigured + empty."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_roundtrip_stamps_records(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with Journal(path, "run-1") as j:
+            j.write({"subsystem": "t", "kind": "a", "payload": {"x": 1}})
+            j.write({"subsystem": "t", "kind": "b"})
+        recs = read_journal(path)
+        assert [r["kind"] for r in recs] == ["a", "b"]
+        assert all(r["run_id"] == "run-1" for r in recs)
+        assert all(r["pid"] == os.getpid() for r in recs)
+        assert [r["seq"] for r in recs] == [0, 1]
+        assert recs[0]["ts_mono_ns"] <= recs[1]["ts_mono_ns"]
+
+    def test_torn_tail_loses_only_last_line(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with Journal(path, "r") as j:
+            for i in range(5):
+                j.write({"kind": "k", "payload": {"i": i}})
+        # Simulate a SIGKILL mid-write: the final line is torn.
+        with open(path, "ab") as f:
+            f.write(b'{"kind": "torn", "payl')
+        recs = read_journal(path)
+        assert [r["payload"]["i"] for r in recs] == [0, 1, 2, 3, 4]
+
+    def test_foreign_garbage_line_is_skipped(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with Journal(path, "r") as j:
+            j.write({"kind": "a"})
+        with open(path, "ab") as f:
+            f.write(b"\x00\xffnot json at all\n")
+            f.write(b"[1, 2, 3]\n")  # parseable but not a record
+        with Journal(path, "r2") as j:
+            j.write({"kind": "b"})
+        assert [r["kind"] for r in read_journal(path)] == ["a", "b"]
+
+    def test_concurrent_writers_lose_nothing(self, tmp_path):
+        # Two Journal instances on the same file (the multi-process
+        # O_APPEND story) hammered by four threads each.
+        path = str(tmp_path / "j.jsonl")
+        writers = [Journal(path, f"w{i}") for i in range(2)]
+        n_threads, n_recs = 4, 200
+
+        def hammer(j, tid):
+            for i in range(n_recs):
+                j.write({"kind": "k", "payload": {"t": tid, "i": i}})
+
+        threads = [
+            threading.Thread(target=hammer, args=(w, f"{wi}-{ti}"))
+            for wi, w in enumerate(writers) for ti in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for w in writers:
+            w.close()
+        recs = read_journal(path)
+        assert len(recs) == 2 * n_threads * n_recs
+        seen = {(r["payload"]["t"], r["payload"]["i"]) for r in recs}
+        assert len(seen) == 2 * n_threads * n_recs
+
+    def test_write_after_close_is_noop(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = Journal(path, "r")
+        j.write({"kind": "a"})
+        j.close()
+        j.write({"kind": "b"})
+        assert [r["kind"] for r in read_journal(path)] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# typed events
+# ---------------------------------------------------------------------------
+
+
+class TestEvents:
+    def test_chaos_grep_strings_are_derived(self):
+        # The literal substrings tools/chaos.py greps for come from the
+        # template table, not the call sites.
+        lvl, line = events_mod.render("data", "worker_death", {
+            "service": "svc", "worker": 1, "why": "died (signal 9)",
+            "lost": 1, "indices": [3], "respawns_left": 2,
+        })
+        assert lvl == logging.WARNING and "respawning" in line
+        _, line = events_mod.render("data", "service_fallback", {
+            "service": "svc", "deaths": 5,
+        })
+        assert "falling back to in-process synchronous assembly" in line
+        _, line = events_mod.render("serve", "fleet_quarantine", {
+            "replica": 2, "reason": "engine dead",
+        })
+        assert line == "fleet: quarantining replica 2: engine dead"
+
+    def test_unknown_kind_renders_open_vocabulary(self):
+        lvl, line = events_mod.render("x", "new_thing", {"a": 1})
+        assert lvl == logging.INFO and "new_thing" in line
+
+    def test_malformed_payload_never_raises(self):
+        lvl, line = events_mod.render("data", "worker_death", {})
+        assert "template error" in line
+
+    def test_emit_unconfigured_feeds_ring_not_disk(self, tmp_path):
+        rec = obs.emit("t", "checkpoint_saved", {"step": 3})
+        assert rec["payload"] == {"step": 3}
+        assert not obs.is_configured()
+        assert any(
+            e.get("kind") == "checkpoint_saved" for e in obs.flight().entries()
+        )
+        assert list(tmp_path.iterdir()) == []
+        assert obs.counter("obs_events_total").value(
+            subsystem="t", kind="checkpoint_saved"
+        ) == 1
+
+    def test_emit_configured_appends_to_journal(self, tmp_path):
+        run = obs.configure(str(tmp_path))
+        obs.emit("t", "checkpoint_saved", {"step": 7})
+        obs.close()
+        recs = read_journal(str(tmp_path / "journal.jsonl"))
+        saved = [r for r in recs if r.get("kind") == "checkpoint_saved"]
+        assert len(saved) == 1
+        assert saved[0]["run_id"] == run
+        assert saved[0]["payload"] == {"step": 7}
+
+    def test_emit_logs_derived_line(self, caplog):
+        with caplog.at_level(logging.INFO, logger="mx_rcnn_tpu.serve"):
+            obs.emit(
+                "serve", "fleet_reinstate", {"replica": 1},
+                logger=logging.getLogger("mx_rcnn_tpu.serve"),
+            )
+        assert "fleet: replica 1 reinstated" in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_basics(self):
+        c = obs.counter("t_total")
+        c.inc()
+        c.inc(2.0, replica="0")
+        assert c.value() == 1.0 and c.value(replica="0") == 2.0
+        g = obs.gauge("t_depth")
+        g.set(5, replica="0")
+        assert g.value(replica="0") == 5.0
+        h = obs.histogram("t_latency_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        assert h.percentile(0.5) == 1.0
+
+    def test_registry_rejects_kind_conflicts(self):
+        obs.counter("t_conflict")
+        with pytest.raises(TypeError, match="already registered"):
+            obs.gauge("t_conflict")
+
+    def test_prometheus_rendering(self):
+        obs.counter("t_total", "help text").inc(replica="0")
+        obs.histogram("t_lat", buckets=(0.1, 1.0)).observe(0.05)
+        text = obs.render_metrics()
+        assert "# TYPE t_total counter" in text
+        assert 't_total{replica="0"} 1' in text
+        assert 't_lat_bucket{le="0.1"} 1' in text
+        assert 't_lat_bucket{le="+Inf"} 1' in text
+        assert "t_lat_count 1" in text
+
+    def test_thread_safety_hammer(self):
+        c = obs.counter("t_hammer_total")
+        g = obs.gauge("t_hammer_depth")
+        h = obs.histogram("t_hammer_lat", buckets=(0.5,))
+        n_threads, n_ops = 8, 1000
+        stop = threading.Event()
+
+        def render_loop():
+            while not stop.is_set():
+                obs.render_metrics()
+                obs.registry().snapshot()
+
+        def hammer(tid):
+            for i in range(n_ops):
+                c.inc(thread=str(tid))
+                c.inc()
+                g.set(i, thread=str(tid))
+                h.observe(i % 2, thread=str(tid))
+
+        renderer = threading.Thread(target=render_loop)
+        renderer.start()
+        threads = [
+            threading.Thread(target=hammer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        renderer.join()
+        assert c.value() == n_threads * n_ops
+        total = sum(
+            c.value(thread=str(t)) for t in range(n_threads)
+        )
+        assert total == n_threads * n_ops
+        snap = obs.registry().snapshot()["t_hammer_lat"]
+        assert sum(s["count"] for s in snap.values()) == n_threads * n_ops
+
+
+# ---------------------------------------------------------------------------
+# /metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestEndpoint:
+    def _get(self, port, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ).read().decode()
+
+    def test_scrape_metrics_healthz_statusz(self, tmp_path):
+        obs.configure(str(tmp_path), metrics_port=0)
+        port = obs.metrics_port()
+        assert port and port > 0
+        obs.counter("t_scrape_total").inc(3)
+        obs.register_status("fleet", lambda: {"alive": True, "n": 2})
+
+        body = self._get(port, "/metrics")
+        assert "t_scrape_total 3" in body
+        # The plane's own event counter is always present (configure
+        # emits an event), so a fresh scrape is never empty.
+        assert "obs_events_total" in body
+
+        assert json.loads(self._get(port, "/healthz"))["ok"] is True
+        statusz = json.loads(self._get(port, "/statusz"))
+        assert statusz["fleet"] == {"alive": True, "n": 2}
+        obs.close()
+
+    def test_unhealthy_provider_fails_healthz(self, tmp_path):
+        obs.configure(str(tmp_path), metrics_port=0)
+        port = obs.metrics_port()
+        obs.register_status("fleet", lambda: {"alive": False})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(port, "/healthz")
+        assert ei.value.code == 503
+        obs.close()
+
+
+# ---------------------------------------------------------------------------
+# span tracing through a hedged fleet request
+# ---------------------------------------------------------------------------
+
+
+def _read_spans(obs_dir):
+    spans = []
+    with open(os.path.join(obs_dir, "spans.jsonl")) as f:
+        for line in f:
+            spans.append(json.loads(line))
+    return spans
+
+
+class TestSpans:
+    def test_span_file_is_chrome_trace_events(self, tmp_path):
+        obs.configure(str(tmp_path))
+        with obs.span("outer", subsystem="test") as s:
+            with s.child("inner"):
+                pass
+        obs.close()
+        spans = _read_spans(str(tmp_path))
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"outer", "inner"}
+        inner, outer = by_name["inner"], by_name["outer"]
+        assert all(s["ph"] == "X" for s in spans)
+        assert inner["args"]["trace_id"] == outer["args"]["trace_id"]
+        assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+        assert outer["dur"] >= inner["dur"] >= 0
+
+    def test_hedged_fleet_request_span_tree(self, tmp_path):
+        obs.configure(str(tmp_path))
+        gate = threading.Event()
+
+        def runner_fn(rid):
+            # Replica 0 wedges; the hedge fires on replica 1 and wins.
+            return FakeRunner(block=gate if rid == 0 else None)
+
+        fleet, _ = _fleet(
+            2, runner_fn=runner_fn, hedge_after=0.05,
+            quarantine_failures=100,
+        )
+        trace_id = obs.new_trace_id()
+        try:
+            with fleet:
+                freq = fleet.submit(_img(8, 8), timeout=10,
+                                    trace_id=trace_id)
+                res = freq.result(10)
+                assert res["replica_id"] == 1
+                assert fleet.stats()["hedges"] == 1
+                gate.set()  # release the straggler so its spans close
+        finally:
+            gate.set()
+        obs.close()
+
+        spans = [
+            s for s in _read_spans(str(tmp_path))
+            if s["args"]["trace_id"] == trace_id
+        ]
+        by_id = {s["args"]["span_id"]: s for s in spans}
+        roots = [s for s in spans if s["args"]["parent_id"] is None]
+        assert len(roots) == 1 and roots[0]["name"] == "request"
+        root = roots[0]
+
+        attempts = [s for s in spans if s["name"] == "attempt"]
+        assert len(attempts) == 2
+        assert all(
+            a["args"]["parent_id"] == root["args"]["span_id"]
+            for a in attempts
+        )
+        assert sorted(a["args"]["hedge"] for a in attempts) == [False, True]
+        hedged = next(a for a in attempts if a["args"]["hedge"])
+        assert hedged["args"]["replica"] == 1
+
+        engine_reqs = [s for s in spans if s["name"] == "engine_request"]
+        assert len(engine_reqs) == 2
+        attempt_ids = {a["args"]["span_id"] for a in attempts}
+        assert all(
+            e["args"]["parent_id"] in attempt_ids for e in engine_reqs
+        )
+        engine_ids = {e["args"]["span_id"] for e in engine_reqs}
+        for name in ("queue", "device"):
+            children = [s for s in spans if s["name"] == name]
+            assert len(children) == 2, name
+            assert all(
+                c["args"]["parent_id"] in engine_ids for c in children
+            ), name
+        # Every span resolves to the single root through parents.
+        for s in spans:
+            cur, hops = s, 0
+            while cur["args"]["parent_id"] is not None:
+                cur = by_id[cur["args"]["parent_id"]]
+                hops += 1
+                assert hops < 10
+            assert cur is root
+
+    def test_spans_disabled_writes_nothing(self, tmp_path):
+        obs.configure(str(tmp_path), spans=False)
+        assert not obs.spans_enabled()
+        runner = FakeRunner()
+        from mx_rcnn_tpu.serve import InferenceEngine
+
+        with InferenceEngine(runner) as e:
+            e.infer(_img(8, 8))
+        obs.close()
+        assert os.path.getsize(str(tmp_path / "spans.jsonl")) == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        from mx_rcnn_tpu.obs import FlightRecorder
+
+        ring = FlightRecorder(size=4)
+        for i in range(10):
+            ring.record({"i": i})
+        assert [e["i"] for e in ring.entries()] == [6, 7, 8, 9]
+
+    def test_dump_unconfigured_returns_none(self):
+        assert obs.flight_dump("test") is None
+
+    def test_engine_kill_dumps_flight(self, tmp_path):
+        from mx_rcnn_tpu.serve import InferenceEngine
+
+        obs.configure(str(tmp_path))
+        e = InferenceEngine(FakeRunner(), replica_id=7).start()
+        e.kill("test kill")
+        obs.close()
+        dumps = sorted(tmp_path.glob("flight_engine_killed_*.json"))
+        assert len(dumps) == 1
+        dump = json.loads(dumps[0].read_text())
+        assert dump["trigger"] == "engine_killed"
+        assert dump["extra"]["replica"] == 7
+        kinds = {e.get("kind") for e in dump["entries"]}
+        assert "engine_killed" in kinds
+        # The dump itself is journaled, so the postmortem is findable
+        # from the journal alone.
+        recs = read_journal(str(tmp_path / "journal.jsonl"))
+        assert any(r.get("kind") == "flight_dump" for r in recs)
+
+    @pytest.mark.slow
+    def test_subprocess_crash_dumps_flight(self, tmp_path):
+        # A real interpreter dying on an unhandled exception must leave
+        # the postmortem artifact behind — the crash-handler contract.
+        script = (
+            "import sys\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            "from mx_rcnn_tpu import obs\n"
+            f"obs.configure({str(tmp_path)!r})\n"
+            "obs.install_crash_handler()\n"
+            "obs.emit('test', 'checkpoint_saved', {'step': 1})\n"
+            "raise RuntimeError('chaos: injected crash')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "chaos: injected crash" in proc.stderr
+        dumps = sorted(tmp_path.glob("flight_crash_*.json"))
+        assert len(dumps) == 1
+        dump = json.loads(dumps[0].read_text())
+        assert dump["trigger"] == "crash"
+        by_kind = {e.get("kind"): e for e in dump["entries"]}
+        assert "checkpoint_saved" in by_kind
+        crash = by_kind["unhandled_exception"]
+        assert crash["payload"]["exc_type"] == "RuntimeError"
+        assert "injected crash" in crash["payload"]["message"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: replica-kill incident timeline via tools/obs_report.py
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentTimeline:
+    def test_replica_kill_timeline_reconstructs(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+
+        obs.configure(str(tmp_path))
+        fleet, _ = _fleet(3, runner_fn=lambda rid: FakeRunner(delay=0.02))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(8)]
+            fleet.kill_replica(1, "chaos: test kill")
+            for r in reqs:
+                r.result(10)
+            assert fleet.stats()["failed"] == 0
+            _wait(lambda: fleet.stats()["reinstatements"] >= 1)
+        obs.close()
+
+        report, spans = obs_report.build_report(str(tmp_path))
+        assert report["journal_records"] > 0
+        # Trace ids are minted even without loadgen stamping them.
+        assert report["spans"]["count"] == len(spans) > 0
+        assert report["spans"]["traces"] >= 8
+
+        kinds = [e["kind"] for e in report["incident_timeline"]]
+        # kill/quarantine -> recover, in journal order.  (An operator
+        # kill quarantines first, which kills the engine; a watchdog
+        # death inverts the pair — either way both precede recovery.)
+        for kind in ("engine_killed", "fleet_quarantine", "fleet_reinstate"):
+            assert kind in kinds, kinds
+        reinstate_at = kinds.index("fleet_reinstate")
+        assert kinds.index("engine_killed") < reinstate_at
+        assert kinds.index("fleet_quarantine") < reinstate_at
+        quarantine = next(
+            e for e in report["incident_timeline"]
+            if e["kind"] == "fleet_quarantine"
+        )
+        assert quarantine["payload"]["replica"] == 1
+
+        triggers = {d["trigger"] for d in report["flight_dumps"]}
+        assert "engine_killed" in triggers
+        assert report["events_by_kind"]["fleet_reinstate"] >= 1

@@ -104,6 +104,25 @@ class TestAstRules:
         src = "def f(x):\n    return float(np.sqrt(x))\n"
         assert lint_source(HEADER + src, "mx_rcnn_tpu/data/loader.py") == []
 
+    def test_obs_import_fires(self):
+        assert rules_of("import mx_rcnn_tpu.obs\n") == ["TPU007"]
+
+    def test_obs_from_import_fires(self):
+        assert rules_of("from mx_rcnn_tpu.obs import journal\n") == ["TPU007"]
+
+    def test_obs_submodule_import_fires(self):
+        assert rules_of("from mx_rcnn_tpu.obs.metrics import Counter\n") == ["TPU007"]
+
+    def test_obs_attr_import_fires(self):
+        assert rules_of("from mx_rcnn_tpu import obs\n") == ["TPU007"]
+
+    def test_obs_sibling_import_exempt(self):
+        assert rules_of("from mx_rcnn_tpu import config\n") == []
+
+    def test_obs_import_exempt_outside_traced_code(self):
+        src = "from mx_rcnn_tpu import obs\n"
+        assert lint_source(HEADER + src, "mx_rcnn_tpu/serve/engine.py") == []
+
 
 # ---------------------------------------------------------------------------
 # Baseline ratchet semantics

@@ -108,6 +108,7 @@ fails.  (`--child*` / `--compare` are internal subprocess entry modes.)
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import shutil
@@ -117,6 +118,7 @@ import sys
 import tempfile
 import threading
 import time
+from typing import Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONFIG = "tiny_synthetic"
@@ -286,8 +288,16 @@ def child_replica_kill_main() -> int:
     retry; the supervisor quarantines, rebuilds and reinstates it."""
     _fleet_cpu(4)
     import numpy as np
+    from mx_rcnn_tpu import obs
     from mx_rcnn_tpu.config import get_config
     from mx_rcnn_tpu.serve import build_fleet
+
+    obs_dir = os.environ.get("MX_RCNN_OBS_DIR")
+    if obs_dir:
+        # Durable observability plane: the parent scenario asserts the
+        # journal + flight-recorder artifacts reconstruct the incident.
+        obs.configure(obs_dir)
+        obs.install_crash_handler()
 
     cfg = get_config(CONFIG)
     variables = _init_variables(cfg, seed=0)
@@ -320,6 +330,8 @@ def child_replica_kill_main() -> int:
     assert s["failed"] == 0, f"accepted requests failed: {s}"
     assert s["quarantines"] >= 1, s
     assert reinstated, "killed replica was never reinstated"
+    if obs_dir:
+        obs.close()
     return 0
 
 
@@ -570,7 +582,8 @@ def compare_main(dir_a: str, dir_b: str) -> int:
 
 def train_argv(workdir: str, steps: int, resume: bool = False,
                cache_dir: str | None = None, service_workers: int = 2,
-               respawns: int = 2) -> list[str]:
+               respawns: int = 2,
+               extra_sets: tuple[str, ...] = ()) -> list[str]:
     # Every train child runs the PRODUCTION input path: process decode
     # workers + the checksummed tensor cache.  The cache root is shared
     # across sibling scenarios by default (one level above the per-
@@ -591,6 +604,8 @@ def train_argv(workdir: str, steps: int, resume: bool = False,
         "--set", f"data.worker_respawns={respawns}",
         "--set", f"data.cache_dir={cache_dir}",
     ]
+    for item in extra_sets:
+        argv += ["--set", item]
     if resume:
         argv.append("--resume")
     return argv
@@ -863,10 +878,12 @@ def scenario_data_worker_kill(root: str, steps: int, timeout: float) -> dict:
     wd = os.path.join(root, "data_worker_kill")
     os.makedirs(wd, exist_ok=True)
     sentinel = os.path.join(wd, "suicide.sentinel")
+    obs_dir = os.path.join(wd, "obs")
     kill_idx = CKPT_EVERY + 1  # mid-epoch, past the first checkpoint
     run_to_completion(
         wd, steps, timeout,
         env={"MX_RCNN_CHAOS_DATA_SUICIDE": f"{kill_idx}:{sentinel}"},
+        extra_sets=("obs.enabled=true", f"obs.dir={obs_dir}"),
     )
     assert finalized_steps(wd)[-1] == steps
     assert os.path.exists(sentinel), (
@@ -878,11 +895,26 @@ def scenario_data_worker_kill(root: str, steps: int, timeout: float) -> dict:
     assert "respawning" in logtxt, (
         "dead worker was never respawned (watchdog missed the death)"
     )
+    # The grep strings above are derived from the typed journal — the
+    # same death must be queryable as a worker_death event with payload.
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from mx_rcnn_tpu.obs import read_journal
+    finally:
+        sys.path.pop(0)
+
+    journal = read_journal(os.path.join(obs_dir, "journal.jsonl"))
+    deaths = [r for r in journal if r.get("kind") == "worker_death"]
+    assert deaths, "journal recorded no worker_death event"
+    assert any(
+        r.get("kind") == "checkpoint_saved" for r in journal
+    ), "journal recorded no checkpoint_saved event"
     assert bitwise_equal(os.path.join(root, "baseline"), wd, timeout), (
         "params diverged after a decode-worker SIGKILL — reassignment "
         "is not schedule-deterministic"
     )
-    return {"killed_batch": kill_idx, "bit_identical": True}
+    return {"killed_batch": kill_idx, "bit_identical": True,
+            "journal_events": len(journal)}
 
 
 def scenario_data_worker_wedge(root: str, steps: int, timeout: float) -> dict:
@@ -1102,13 +1134,15 @@ def scenario_eval_corrupt(root: str, steps: int, timeout: float) -> dict:
     return {"quarantined": sorted(quarantined), "dump_images": len(dump)}
 
 
-def _json_child(root: str, name: str, flag: str, timeout: float) -> dict:
+def _json_child(root: str, name: str, flag: str, timeout: float,
+                env: Optional[dict] = None) -> dict:
     """Run a self-asserting child mode; return its JSON stdout line."""
     wd = os.path.join(root, name)
     os.makedirs(wd, exist_ok=True)
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), flag],
         capture_output=True, text=True, timeout=timeout, cwd=REPO_ROOT,
+        env={**os.environ, **env} if env else None,
     )
     with open(os.path.join(wd, "child.log"), "w") as f:
         f.write(out.stdout + out.stderr)
@@ -1138,9 +1172,45 @@ def scenario_hang(root: str, steps: int, timeout: float) -> dict:
 
 
 def scenario_replica_kill(root: str, steps: int, timeout: float) -> dict:
-    r = _json_child(root, "replica_kill", "--child-replica-kill", timeout)
+    # Journal enabled: on top of the child's own zero-loss assertions,
+    # the scenario proves the incident is reconstructable from the obs
+    # artifacts alone (docs/observability.md).
+    obs_dir = os.path.join(root, "replica_kill", "obs")
+    r = _json_child(root, "replica_kill", "--child-replica-kill", timeout,
+                    env={"MX_RCNN_OBS_DIR": obs_dir})
     assert r["failed"] == 0 and r["completed"] == r["accepted"], r
     assert r["quarantines"] >= 1 and r["reinstatements"] >= 1, r
+
+    # The flight recorder fired on the kill and captured the killing
+    # event in its postmortem ring.
+    dumps = sorted(glob.glob(os.path.join(obs_dir, "flight_*.json")))
+    assert dumps, f"no flight-recorder dump under {obs_dir}"
+    dump_kinds: set = set()
+    for path in dumps:
+        with open(path) as f:
+            dump_kinds.update(
+                e.get("kind") for e in json.load(f)["entries"]
+                if isinstance(e, dict)
+            )
+    assert "engine_killed" in dump_kinds, sorted(
+        k for k in dump_kinds if k
+    )
+
+    # The journal alone reconstructs kill -> quarantine -> reinstate.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    report, _ = obs_report.build_report(obs_dir)
+    tl = [e["kind"] for e in report["incident_timeline"]]
+    for kind in ("engine_killed", "fleet_quarantine", "fleet_reinstate"):
+        assert kind in tl, tl
+    assert max(
+        tl.index("engine_killed"), tl.index("fleet_quarantine")
+    ) < tl.index("fleet_reinstate"), tl
+    r["obs_events"] = report["journal_records"]
+    r["flight_dumps"] = len(dumps)
     return r
 
 

@@ -65,6 +65,18 @@ def run_bench(args: argparse.Namespace) -> dict:
     from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
     from mx_rcnn_tpu.serve import Overloaded, ServeError, build_fleet
 
+    from mx_rcnn_tpu import obs
+
+    obs_on = bool(args.obs_dir)
+    if obs_on:
+        # Durable plane: journal + per-request spans under --obs-dir and
+        # (optionally) a live /metrics endpoint to scrape mid-run.
+        obs.configure(
+            args.obs_dir, metrics_port=args.metrics_port, flush_s=5.0
+        )
+        print(f"[loadgen] obs: run_id={obs.run_id()} dir={obs.out_dir()} "
+              f"metrics_port={obs.metrics_port()}", file=sys.stderr)
+
     cfg = get_config(args.config)
     variables = init_detector(
         TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
@@ -80,6 +92,8 @@ def run_bench(args: argparse.Namespace) -> dict:
           f"(warmup compiles)...", file=sys.stderr)
     fleet.start()
     print("[loadgen] fleet ready", file=sys.stderr)
+    if obs_on:
+        obs.register_status("fleet", fleet.stats)
 
     rng = np.random.default_rng(0)
     h, w = cfg.data.image_size
@@ -124,9 +138,13 @@ def run_bench(args: argparse.Namespace) -> dict:
             fleet.kill_replica(0, "loadgen --kill-one")
             print(f"[loadgen] killed replica 0 at "
                   f"t={now - t0:.1f}s", file=sys.stderr)
+        # Every synthetic request carries its own trace id; with --obs-dir
+        # the whole span tree (request -> attempt -> engine queue/device)
+        # lands in <obs-dir>/spans.jsonl keyed by it.
+        trace_id = obs.new_trace_id() if obs_on else None
         try:
             freq = fleet.submit(images[submitted % len(images)],
-                                timeout=args.deadline)
+                                timeout=args.deadline, trace_id=trace_id)
         except Overloaded:
             with lock:
                 submitted += 1
@@ -171,6 +189,30 @@ def run_bench(args: argparse.Namespace) -> dict:
         "retries": stats["retries"],
         "generation": stats["generation"],
     }
+    if obs_on:
+        port = obs.metrics_port()
+        if port is not None:
+            # Self-scrape: prove the endpoint serves non-empty metrics
+            # for the run we just generated.
+            import urllib.request
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            n_series = sum(
+                1 for ln in body.splitlines()
+                if ln and not ln.startswith("#")
+            )
+            print(f"[loadgen] /metrics scrape: {n_series} series",
+                  file=sys.stderr)
+            rec["metrics_series"] = n_series
+        rec["obs"] = {
+            "run_id": obs.run_id(),
+            "dir": obs.out_dir(),
+            "journal": os.path.join(obs.out_dir(), "journal.jsonl"),
+            "spans": os.path.join(obs.out_dir(), "spans.jsonl"),
+        }
+        obs.close()
     return rec
 
 
@@ -191,6 +233,12 @@ def main(argv=None) -> int:
     p.add_argument("--assert-p99", type=float, default=None,
                    help="exit nonzero unless p99 latency (s) is under "
                         "this bound and no accepted request failed")
+    p.add_argument("--obs-dir", default=None,
+                   help="write the obs journal, per-request span files "
+                        "and flight dumps under this directory")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="with --obs-dir: bind /metrics here (0 = "
+                        "ephemeral, shown on stderr)")
     args = p.parse_args(argv)
     _hermetic_cpu(args.replicas)
 

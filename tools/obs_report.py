@@ -1,0 +1,194 @@
+"""Merge one run's observability artifacts into a single report.
+
+Inputs (all optional — the report carries whatever exists):
+
+* ``<obs-dir>/journal.jsonl``   — typed event journal (mx_rcnn_tpu.obs)
+* ``<obs-dir>/spans.jsonl``     — finished spans, one Chrome-trace event
+  per line
+* ``<obs-dir>/flight_*.json``   — flight-recorder postmortem dumps
+* ``--stage-log`` file(s)       — bench/chaos stdout with ``{"metric":
+  ...}`` JSON lines (train_stage_ms breakdowns, BENCH headlines)
+
+Outputs:
+
+* ``artifacts/obs_report.json`` (``--out``) — counts per event kind, the
+  reconstructed **incident timeline** (kill -> detect -> quarantine/reap
+  -> rebuild/respawn -> recover, in journal order), flight-dump summaries
+  and any stage/headline lines.
+* ``<obs-dir>/trace.json`` (``--trace-out``) — the span lines wrapped in
+  a Chrome-trace ``{"traceEvents": [...]}`` array, loadable in Perfetto
+  next to the jax.profiler dumps.
+
+Usage:
+    python tools/obs_report.py --obs-dir /tmp/run/obs \\
+        --stage-log /tmp/run/bench.log --out artifacts/obs_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Event kinds that mark state changes in an incident, in no particular
+# order — the TIMELINE order comes from the journal, these only filter
+# routine chatter (metrics_flush, shed) out of it.
+INCIDENT_KINDS = frozenset({
+    "worker_death", "worker_retired", "worker_wedged", "service_fallback",
+    "cache_quarantine",
+    "guardian_rollback", "rollback_restored", "guardian_loss_spike",
+    "training_diverged", "preempt_drain",
+    "checkpoint_saved", "checkpoint_restored",
+    "engine_dead", "engine_killed",
+    "fleet_quarantine", "fleet_reinstate", "fleet_retire", "weight_swap",
+    "breaker_transition", "ladder_transition",
+})
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "rb") as f:
+        for line in f:
+            try:
+                rec = json.loads(line.decode("utf-8", "replace"))
+            except ValueError:
+                continue  # torn/corrupt line — same tolerance as obs.journal
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _order_key(rec: dict):
+    # Wall clock first (cross-process), monotonic as the tiebreaker
+    # (same-process events can share a rounded wall timestamp).
+    return (rec.get("ts", 0.0), rec.get("ts_mono_ns", 0))
+
+
+def build_report(
+    obs_dir: str, stage_logs: tuple[str, ...] = ()
+) -> tuple[dict, list[dict]]:
+    """(report dict, chrome-trace span events) for one obs directory."""
+    journal = sorted(
+        _read_jsonl(os.path.join(obs_dir, "journal.jsonl")), key=_order_key
+    )
+    spans = _read_jsonl(os.path.join(obs_dir, "spans.jsonl"))
+    flights = []
+    for path in sorted(glob.glob(os.path.join(obs_dir, "flight_*.json"))):
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, ValueError):
+            continue
+        flights.append({
+            "path": path,
+            "trigger": dump.get("trigger"),
+            "run_id": dump.get("run_id"),
+            "entries": len(dump.get("entries", [])),
+            "kinds": sorted({
+                e.get("kind") for e in dump.get("entries", [])
+                if isinstance(e, dict) and e.get("kind")
+            }),
+        })
+
+    t0 = journal[0]["ts"] if journal else 0.0
+    events_by_kind: dict[str, int] = {}
+    timeline = []
+    for rec in journal:
+        kind = rec.get("kind", "?")
+        events_by_kind[kind] = events_by_kind.get(kind, 0) + 1
+        if kind in INCIDENT_KINDS:
+            timeline.append({
+                "t_s": round(rec.get("ts", t0) - t0, 3),
+                "subsystem": rec.get("subsystem"),
+                "kind": kind,
+                "pid": rec.get("pid"),
+                "payload": rec.get("payload", {}),
+            })
+
+    stage_lines = []
+    for log_path in stage_logs:
+        if not os.path.exists(log_path):
+            continue
+        with open(log_path, "rb") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line.decode("utf-8", "replace"))
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and ("metric" in rec or "bench" in rec):
+                    stage_lines.append(rec)
+
+    traces: dict[str, int] = {}
+    for s in spans:
+        tid = (s.get("args") or {}).get("trace_id")
+        if tid:
+            traces[tid] = traces.get(tid, 0) + 1
+
+    report = {
+        "obs_dir": os.path.abspath(obs_dir),
+        "run_ids": sorted({r.get("run_id", "-") for r in journal}),
+        "journal_records": len(journal),
+        "events_by_kind": dict(sorted(events_by_kind.items())),
+        "incident_timeline": timeline,
+        "spans": {
+            "count": len(spans),
+            "traces": len(traces),
+            "max_spans_per_trace": max(traces.values(), default=0),
+        },
+        "flight_dumps": flights,
+        "stage_lines": stage_lines,
+    }
+    return report, spans
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--obs-dir", required=True,
+                   help="directory obs.configure() wrote into")
+    p.add_argument("--stage-log", action="append", default=[],
+                   help="bench/chaos log with JSON metric lines "
+                        "(repeatable)")
+    p.add_argument("--out", default="artifacts/obs_report.json")
+    p.add_argument("--trace-out", default=None,
+                   help="Chrome-trace wrap of spans.jsonl (default: "
+                        "<obs-dir>/trace.json; 'none' to skip)")
+    args = p.parse_args(argv)
+
+    report, spans = build_report(args.obs_dir, tuple(args.stage_log))
+
+    trace_out = args.trace_out
+    if trace_out is None:
+        trace_out = os.path.join(args.obs_dir, "trace.json")
+    if trace_out != "none" and spans:
+        with open(trace_out, "w") as f:
+            json.dump({"traceEvents": spans}, f)
+        report["trace_file"] = os.path.abspath(trace_out)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(f"[obs_report] {report['journal_records']} journal record(s), "
+          f"{report['spans']['count']} span(s), "
+          f"{len(report['flight_dumps'])} flight dump(s) -> {args.out}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "obs_report",
+        "value": {
+            "events": report["journal_records"],
+            "incidents": len(report["incident_timeline"]),
+            "spans": report["spans"]["count"],
+            "flight_dumps": len(report["flight_dumps"]),
+        },
+        "path": os.path.abspath(args.out),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
