@@ -37,10 +37,12 @@ TPU005 unscoped-mxu     conv/dot-emitting calls in a plain function with
                         module scope — their FLOPs land in hlo_profile's
                         "other" bucket, breaking per-component MFU
                         attribution.
-TPU007 obs-in-trace     any import of ``mx_rcnn_tpu.obs`` in traced code.
-                        The observability plane is host-side by contract
-                        (journal writes, HTTP endpoint, wall clocks): an
-                        emit/span/counter inside a jitted module would at
+TPU007 host-in-trace    any import of ``mx_rcnn_tpu.obs`` or
+                        ``mx_rcnn_tpu.ctrl`` in traced code.  The
+                        observability and control planes are host-side by
+                        contract (journal writes, HTTP endpoint, wall
+                        clocks, fleet mutation): an emit/span/counter or
+                        autoscaler call inside a jitted module would at
                         best bake trace-time values and at worst sync or
                         do I/O per step.  (TPU006 is the dynamic bf16
                         upcast walk in tools/tpulint.py.)
@@ -77,9 +79,15 @@ RULES: dict[str, str] = {
               "(trace-order nondeterminism)",
     "TPU005": "MXU-emitting op outside any jax.named_scope / flax module "
               "(unattributable FLOPs)",
-    "TPU007": "mx_rcnn_tpu.obs imported in jit-traced code (the "
-              "observability plane is host-side only)",
+    "TPU007": "mx_rcnn_tpu.obs/ctrl imported in jit-traced code (the "
+              "observability and control planes are host-side only)",
 }
+
+# Host-only top-level packages TPU007 fences out of traced code.
+_HOST_ONLY_PKGS: tuple[str, ...] = ("obs", "ctrl")
+_HOST_ONLY_MODULES: tuple[str, ...] = tuple(
+    f"mx_rcnn_tpu.{p}" for p in _HOST_ONLY_PKGS
+)
 
 # TPU001: numpy calls that materialize/cast an array on host.
 _HOST_CAST_NP = {"asarray", "array"}
@@ -233,8 +241,9 @@ class _Linter(ast.NodeVisitor):
     def visit_Import(self, node: ast.Import) -> None:
         self.imports.visit_import(node)
         for a in node.names:
-            if a.name == "mx_rcnn_tpu.obs" or a.name.startswith(
-                "mx_rcnn_tpu.obs."
+            if any(
+                a.name == mod or a.name.startswith(mod + ".")
+                for mod in _HOST_ONLY_MODULES
             ):
                 self._emit("TPU007", node)
         self.generic_visit(node)
@@ -242,10 +251,12 @@ class _Linter(ast.NodeVisitor):
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         self.imports.visit_import_from(node)
         mod = node.module or ""
-        if mod == "mx_rcnn_tpu.obs" or mod.startswith("mx_rcnn_tpu.obs."):
+        if any(
+            mod == m or mod.startswith(m + ".") for m in _HOST_ONLY_MODULES
+        ):
             self._emit("TPU007", node)
         elif mod == "mx_rcnn_tpu" and any(
-            a.name == "obs" for a in node.names
+            a.name in _HOST_ONLY_PKGS for a in node.names
         ):
             self._emit("TPU007", node)
         self.generic_visit(node)

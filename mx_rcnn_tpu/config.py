@@ -426,12 +426,55 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class CtrlConfig:
+    """Closed-loop control plane (mx_rcnn_tpu/ctrl/): SLO burn-rate
+    alerting and the SLO-driven autoscaler.  Host-side by construction —
+    tpulint TPU007 keeps ctrl (like obs) out of traced modules, so none
+    of these knobs can change a compiled program."""
+
+    # Master switch: serving entrypoints that honour it (tools/soak.py)
+    # run the autoscaler + SLO engine next to the fleet.
+    enabled: bool = False
+    # Autoscaler fleet bounds and pressure thresholds
+    # (ctrl/autoscale.py).  Load is mean inflight+queue per routable
+    # replica; shed_high is sheds/second over the evaluation window.
+    min_replicas: int = 1
+    max_replicas: int = 8
+    load_high: float = 4.0
+    load_low: float = 0.5
+    shed_high: float = 0.0
+    # Windowed p99 (seconds) that counts as pressure; 0 disables the
+    # latency signal.
+    p99_high_s: float = 0.0
+    # Scale-down hysteresis (mirrors serve/degrade.py HysteresisPlanner:
+    # scale-UP is immediate, scale-DOWN needs this many consecutive
+    # comfortable evaluations) + per-direction cooldowns.
+    down_dwell: int = 3
+    up_cooldown_s: float = 5.0
+    down_cooldown_s: float = 15.0
+    # Seconds between autoscaler/SLO evaluations.
+    period_s: float = 1.0
+    # Default SLOs (ctrl/slo.py): availability over fleet request
+    # outcomes, and a latency SLO ("latency_target" of requests under
+    # "latency_threshold_s").
+    availability_target: float = 0.99
+    latency_target: float = 0.99
+    latency_threshold_s: float = 30.0
+    # Multi-window burn-rate alerting: alert when the burn over BOTH
+    # windows exceeds burn_factor x the budget rate.
+    burn_fast_s: float = 300.0
+    burn_slow_s: float = 3600.0
+    burn_factor: float = 2.0
+
+
+@dataclass(frozen=True)
 class Config:
     name: str = "faster_rcnn_r50_fpn_coco"
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    ctrl: CtrlConfig = field(default_factory=CtrlConfig)
     workdir: str = "runs"
 
 

@@ -1,0 +1,61 @@
+"""Closed-loop control plane: SLOs, burn-rate alerts, autoscaling.
+
+The observability plane (``mx_rcnn_tpu.obs``) *watches* the serving
+stack; this package *acts* on what it sees:
+
+* ``ctrl/slo.py`` — declarative :class:`SLO` objects evaluated over
+  metrics ``Registry`` snapshots, with SRE-style multi-window burn-rate
+  alerting journaled as typed events and the remaining error budget
+  exported on ``/metrics``.
+* ``ctrl/autoscale.py`` — an :class:`Autoscaler` policy loop that turns
+  queue-depth / shed-rate / windowed-p99 pressure into
+  ``FleetRouter.add_replica()`` / ``retire_replica()`` calls, with
+  scale-down hysteresis mirroring ``serve/degrade.HysteresisPlanner``.
+
+Everything here is host-side control logic: tpulint's TPU007 rule bans
+``mx_rcnn_tpu.ctrl`` imports from jit-traced modules, exactly as it
+does for ``mx_rcnn_tpu.obs``.  Knobs live under ``cfg.ctrl``
+(:class:`mx_rcnn_tpu.config.CtrlConfig`); see docs/autoscaling.md.
+"""
+
+from mx_rcnn_tpu.ctrl.autoscale import (
+    Autoscaler,
+    ScalePolicy,
+    ScaleSignals,
+    desired_action,
+)
+from mx_rcnn_tpu.ctrl.slo import (
+    SLO,
+    SLOEngine,
+    default_slos,
+    good_total,
+    merged_percentile,
+)
+
+
+def build_controller(cfg, fleet):
+    """(SLOEngine, Autoscaler) pair wired from ``cfg.ctrl`` — neither
+    loop started; callers pick the period (``cfg.ctrl.period_s``)."""
+    ctrl = cfg.ctrl
+    engine = SLOEngine(
+        default_slos(ctrl),
+        fast_s=ctrl.burn_fast_s,
+        slow_s=ctrl.burn_slow_s,
+        burn_factor=ctrl.burn_factor,
+    )
+    scaler = Autoscaler(fleet, ScalePolicy.from_config(ctrl))
+    return engine, scaler
+
+
+__all__ = [
+    "SLO",
+    "SLOEngine",
+    "default_slos",
+    "good_total",
+    "merged_percentile",
+    "Autoscaler",
+    "ScalePolicy",
+    "ScaleSignals",
+    "desired_action",
+    "build_controller",
+]

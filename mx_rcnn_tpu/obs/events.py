@@ -157,6 +157,46 @@ def _fmt_metrics_flush(p: dict) -> str:
     )
 
 
+def _fmt_slo_burn_start(p: dict) -> str:
+    return (
+        "slo {slo}: burn-rate alert START — {burn_fast:.1f}x over "
+        "{fast_s:.0f}s and {burn_slow:.1f}x over {slow_s:.0f}s "
+        "(budget remaining {budget_remaining:.1%})"
+    ).format(**p)
+
+
+def _fmt_slo_burn_stop(p: dict) -> str:
+    return (
+        "slo {slo}: burn-rate alert STOP after {active_s:.1f}s "
+        "(budget remaining {budget_remaining:.1%})"
+    ).format(**p)
+
+
+def _fmt_fleet_scale_up(p: dict) -> str:
+    return (
+        "autoscaler: scale up {size} -> {target} ({reason})"
+    ).format(**p)
+
+
+def _fmt_fleet_scale_down(p: dict) -> str:
+    return (
+        "autoscaler: scale down {size} -> {target} after {dwell} "
+        "comfortable evaluation(s) ({reason})"
+    ).format(**p)
+
+
+def _fmt_fleet_replica_added(p: dict) -> str:
+    return (
+        "fleet: replica {replica} added (generation {generation})"
+    ).format(**p)
+
+
+def _fmt_fleet_replica_retired(p: dict) -> str:
+    return (
+        "fleet: replica {replica} retired after drain ({reason})"
+    ).format(**p)
+
+
 # kind -> (logging level, payload -> line).  Level is the default; emit()
 # callers cannot override the line, only the destination logger.
 EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
@@ -183,6 +223,13 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "fleet_reinstate": (logging.INFO, _fmt_fleet_reinstate),
     "fleet_retire": (logging.ERROR, _fmt_fleet_retire),
     "weight_swap": (logging.INFO, _fmt_weight_swap),
+    "fleet_replica_added": (logging.INFO, _fmt_fleet_replica_added),
+    "fleet_replica_retired": (logging.INFO, _fmt_fleet_replica_retired),
+    # control plane (mx_rcnn_tpu/ctrl/)
+    "slo_burn_start": (logging.WARNING, _fmt_slo_burn_start),
+    "slo_burn_stop": (logging.INFO, _fmt_slo_burn_stop),
+    "fleet_scale_up": (logging.WARNING, _fmt_fleet_scale_up),
+    "fleet_scale_down": (logging.INFO, _fmt_fleet_scale_down),
     # plane-internal
     "metrics_flush": (logging.DEBUG, _fmt_metrics_flush),
 }

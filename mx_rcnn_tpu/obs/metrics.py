@@ -19,11 +19,13 @@ rules need.  The default buckets cover serving latencies from 1 ms to
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Iterable, Optional, Sequence
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "Registry",
+    "Counter", "Gauge", "Histogram", "Registry", "SnapshotWindow",
+    "snapshot_delta", "parse_labels", "percentile_from_counts",
     "DEFAULT_LATENCY_BUCKETS_S",
 ]
 
@@ -199,13 +201,19 @@ class Histogram(_Metric):
     def snapshot(self) -> dict:
         out = {}
         with self._lock:
-            items = list(self._series.items())
+            items = [(k, (list(s[0]), s[1], s[2]))
+                     for k, s in self._series.items()]
         for key, (counts, count, total) in items:
             out[_label_str(key) or ""] = {
                 "count": count,
                 "sum": total,
                 "p50": self.percentile(0.50, **dict(key)),
                 "p99": self.percentile(0.99, **dict(key)),
+                # Raw per-bucket counts + upper bounds: what windowed
+                # deltas (snapshot_delta) need to rebuild a percentile
+                # over just the window, not the whole run.
+                "le": list(self.buckets),
+                "buckets": counts,
             }
         return out
 
@@ -256,3 +264,164 @@ class Registry:
         """JSON-able {name: {labelstr: value|hist-summary}} for the
         periodic journal flush."""
         return {m.name: m.snapshot() for m in self.families()}
+
+
+# ---------------------------------------------------------------------------
+# Windowed snapshot deltas (burn-rate / autoscaler math without
+# re-scraping Prometheus text)
+# ---------------------------------------------------------------------------
+
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_labels(labelstr: str) -> dict:
+    """``'{level="full",replica="0"}'`` -> ``{"level": "full", ...}``."""
+    return dict(_LABEL_RE.findall(labelstr or ""))
+
+
+def percentile_from_counts(
+    le: Sequence[float], counts: Sequence[int], q: float
+) -> Optional[float]:
+    """Bucket-upper-bound q-quantile over raw (non-cumulative) bucket
+    counts — same estimator as :meth:`Histogram.percentile`, usable on a
+    windowed delta.  None when the counts are empty; +inf when the rank
+    falls past the last finite bucket."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    for b, c in zip(le, counts):
+        cum += c
+        if cum >= rank and c:
+            return b
+    return float("inf")
+
+
+def _series_delta(older, newer):
+    """Delta of one series value (counter float or histogram summary).
+    Counter resets (newer < older) clamp to the newer value, the usual
+    rate() convention."""
+    if isinstance(newer, dict):
+        old = older if isinstance(older, dict) else {}
+        oc = old.get("buckets") or []
+        nc = newer.get("buckets") or []
+        if len(oc) != len(nc):
+            oc = [0] * len(nc)
+        counts = [max(0, n - o) for n, o in zip(nc, oc)]
+        le = newer.get("le") or []
+        dcount = newer.get("count", 0) - old.get("count", 0)
+        if dcount < 0:
+            dcount, counts = newer.get("count", 0), list(nc)
+        return {
+            "count": dcount,
+            "sum": newer.get("sum", 0.0) - old.get("sum", 0.0),
+            "le": list(le),
+            "buckets": counts,
+            "p50": percentile_from_counts(le, counts, 0.50),
+            "p99": percentile_from_counts(le, counts, 0.99),
+        }
+    new = float(newer)
+    old = float(older) if isinstance(older, (int, float)) else 0.0
+    return new if new < old else new - old
+
+
+def snapshot_delta(older: dict, newer: dict) -> dict:
+    """Per-series difference between two :meth:`Registry.snapshot`
+    dicts: counters become increments over the interval, histogram
+    summaries become windowed count/sum/buckets with percentiles
+    recomputed over just the window.  Gauges are point-in-time, so a
+    delta is meaningless — callers should read gauges from ``newer``
+    directly; here they fall through the counter rule (delta of the
+    stored value), which is still the honest interval change."""
+    older = older or {}
+    out: dict = {}
+    for name, series in newer.items():
+        old_series = older.get(name, {})
+        out[name] = {
+            label: _series_delta(old_series.get(label), value)
+            for label, value in series.items()
+        }
+    return out
+
+
+class SnapshotWindow:
+    """Rolling ``(t, Registry.snapshot())`` pairs with rate/delta reads.
+
+    The SLO engine and autoscaler (mx_rcnn_tpu/ctrl/) call
+    :meth:`observe` once per evaluation period and read
+    :meth:`delta_over` / :meth:`rate` instead of re-scraping the
+    Prometheus text endpoint.  Thread-safe; bounded by ``horizon_s``
+    (entries older than the horizon are dropped on observe).
+    """
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        horizon_s: float = 4000.0,
+    ) -> None:
+        self._registry = registry
+        self.horizon_s = float(horizon_s)
+        self._lock = threading.Lock()
+        self._entries: list[tuple[float, dict]] = []
+
+    def observe(self, t: float, snapshot: Optional[dict] = None) -> dict:
+        """Record one snapshot at time ``t`` (monotonic or epoch — any
+        clock, as long as it is THE clock for this window).  Taken from
+        the attached registry when not given."""
+        if snapshot is None:
+            if self._registry is None:
+                raise ValueError("no snapshot given and no registry attached")
+            snapshot = self._registry.snapshot()
+        with self._lock:
+            self._entries.append((float(t), snapshot))
+            floor = float(t) - self.horizon_s
+            while len(self._entries) > 1 and self._entries[0][0] < floor:
+                self._entries.pop(0)
+        return snapshot
+
+    def latest(self) -> Optional[tuple[float, dict]]:
+        with self._lock:
+            return self._entries[-1] if self._entries else None
+
+    def span_s(self) -> float:
+        """Seconds between the oldest and newest recorded snapshots."""
+        with self._lock:
+            if len(self._entries) < 2:
+                return 0.0
+            return self._entries[-1][0] - self._entries[0][0]
+
+    def delta_over(self, window_s: float) -> tuple[float, dict]:
+        """(actual seconds covered, snapshot_delta) between the newest
+        entry and the newest entry at least ``window_s`` older — or the
+        oldest available when the window has not filled yet.  ``(0.0,
+        {})`` with fewer than two entries."""
+        with self._lock:
+            if len(self._entries) < 2:
+                return 0.0, {}
+            t_new, newest = self._entries[-1]
+            base = self._entries[0]
+            for entry in reversed(self._entries[:-1]):
+                if t_new - entry[0] >= window_s:
+                    base = entry
+                    break
+            t_old, oldest = base
+        return t_new - t_old, snapshot_delta(oldest, newest)
+
+    def rate(self, name: str, label: str = "",
+             window_s: float = 60.0) -> Optional[float]:
+        """Per-second increase of counter ``name``/``label`` over the
+        last ``window_s`` (labels summed when ``label`` is "" and the
+        series is labelled).  None before two snapshots exist."""
+        dt, delta = self.delta_over(window_s)
+        if dt <= 0:
+            return None
+        series = delta.get(name)
+        if not series:
+            return 0.0
+        if label in series and not isinstance(series[label], dict):
+            return series[label] / dt
+        total = sum(
+            v for v in series.values() if isinstance(v, (int, float))
+        )
+        return total / dt

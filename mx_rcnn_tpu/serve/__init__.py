@@ -34,7 +34,10 @@ from mx_rcnn_tpu.serve.router import (
     DEGRADED,
     QUARANTINED,
     READY,
+    RETIRING,
     ReplicaView,
+    mean_load,
+    routable_views,
     select_replica,
 )
 
@@ -61,6 +64,9 @@ __all__ = [
     "DEGRADED",
     "QUARANTINED",
     "READY",
+    "RETIRING",
     "ReplicaView",
+    "mean_load",
+    "routable_views",
     "select_replica",
 ]

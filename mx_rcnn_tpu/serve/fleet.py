@@ -30,6 +30,14 @@ disposable:
   callers pair it with SIGTERM → exit
   ``train/preemption.py::RESUMABLE_EXIT_CODE`` (75), the same
   convention the trainer uses for preemption.
+* **Dynamic fleet** — ``add_replica()`` grows the set (background build
+  + warmup on the rebuild machinery, aligned to the current weight
+  generation) and ``retire_replica(rid)`` shrinks it (stop admitting →
+  drain accepted work → release the slot), so the autoscaler
+  (mx_rcnn_tpu/ctrl/autoscale.py) can resize under load.  Replica ids
+  are never reused: the live set is a SPARSE dict keyed by rid, and
+  every policy decision goes through rid-agnostic views
+  (serve/router.py).
 """
 
 from __future__ import annotations
@@ -52,6 +60,7 @@ from mx_rcnn_tpu.serve.router import (
     DEGRADED,
     QUARANTINED,
     READY,
+    RETIRING,
     ROUTABLE,
     ReplicaView,
     auto_hedge_delay,
@@ -198,7 +207,12 @@ class FleetRouter:
         self._clock = clock
         self._lock = threading.Lock()
         self._swap_lock = threading.Lock()
-        self._replicas = [_Replica(rid) for rid in range(n_replicas)]
+        # SPARSE rid -> replica map: retire_replica leaves holes,
+        # add_replica appends fresh never-reused rids.
+        self._replicas: dict[int, _Replica] = {
+            rid: _Replica(rid) for rid in range(n_replicas)
+        }
+        self._next_rid = n_replicas
         self._weights = None       # last swapped tree (rebuild alignment)
         self._generation = 0
         self._pending = 0
@@ -217,13 +231,27 @@ class FleetRouter:
         self._retries_total = 0
         self._quarantines = 0
         self._reinstatements = 0
+        self._added = 0
+        self._retired = 0
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _reps(self) -> list[_Replica]:
+        """Lock-consistent snapshot of the live replica records — the
+        map mutates under add/retire, so no iteration may walk it raw."""
+        with self._lock:
+            return list(self._replicas.values())
+
+    def _count_outcome(self, outcome: str) -> None:
+        obs.counter(
+            "fleet_requests_total",
+            "fleet requests by final outcome",
+        ).inc(outcome=outcome)
 
     def start(self) -> "FleetRouter":
         if self._started:
             return self
-        for r in self._replicas:
+        for r in self._reps():
             r.engine = self._engine_factory(r.rid)
             r.engine.start()
             r.state = READY
@@ -243,7 +271,7 @@ class FleetRouter:
         self._stop_event.set()
         if self._supervisor is not None:
             self._supervisor.join(timeout)
-        for r in self._replicas:
+        for r in self._reps():
             if r.engine is None:
                 continue
             try:
@@ -253,7 +281,7 @@ class FleetRouter:
         # A rebuild caught mid-compile cannot be interrupted; wait it
         # out rather than exit the interpreter under a live XLA thread
         # (which aborts the process instead of raising).
-        for r in self._replicas:
+        for r in self._reps():
             t = r.rebuild_thread
             if t is not None and t.is_alive():
                 t.join(timeout)
@@ -314,6 +342,7 @@ class FleetRouter:
             with self._lock:
                 self._submitted += 1
                 self._shed += 1
+            self._count_outcome("shed")
             if freq.span is not None:
                 freq.span.end(error="Overloaded")
             raise
@@ -321,6 +350,7 @@ class FleetRouter:
             with self._lock:
                 self._submitted += 1
                 self._failed += 1
+            self._count_outcome("failed")
             if freq.span is not None:
                 freq.span.end(error=type(e).__name__)
             raise
@@ -349,7 +379,8 @@ class FleetRouter:
                 self._weights = variables
                 self._generation = target
                 live = [
-                    r for r in self._replicas if r.state in ROUTABLE
+                    r for r in self._replicas.values()
+                    if r.state in ROUTABLE
                 ]
             for r in live:
                 try:
@@ -367,7 +398,11 @@ class FleetRouter:
     def kill_replica(self, rid: int, reason: str = "operator kill") -> None:
         """Chaos/ops hook: hard-kill one replica.  Its accepted work
         fails over through retry; the supervisor rebuilds it."""
-        self._quarantine(self._replicas[rid], reason)
+        with self._lock:
+            r = self._replicas.get(rid)
+        if r is None:
+            raise KeyError(f"no replica {rid} in the fleet")
+        self._quarantine(r, reason)
 
     @property
     def generation(self) -> int:
@@ -382,7 +417,7 @@ class FleetRouter:
     def stats(self) -> dict:
         with self._lock:
             out = {
-                "replicas": self.n_replicas,
+                "replicas": len(self._replicas),
                 "generation": self._generation,
                 "pending": self._pending,
                 "draining": self._draining,
@@ -395,11 +430,13 @@ class FleetRouter:
                 "retries": self._retries_total,
                 "quarantines": self._quarantines,
                 "reinstatements": self._reinstatements,
+                "added": self._added,
+                "retired": self._retired,
             }
             reps = [
                 (r.rid, r.state, r.inflight, r.fail_streak, r.rebuilds,
                  r.engine)
-                for r in self._replicas
+                for r in self._replicas.values()
             ]
         out["replica"] = [
             {
@@ -420,7 +457,7 @@ class FleetRouter:
         shape = getattr(image, "shape", None)
         if not shape or len(shape) < 2:
             return None
-        for r in self._replicas:
+        for r in self._reps():
             if r.state in ROUTABLE and r.engine is not None:
                 try:
                     return tuple(
@@ -434,7 +471,7 @@ class FleetRouter:
         with self._lock:
             reps = [
                 (r.rid, r.state, r.inflight, r.engine)
-                for r in self._replicas
+                for r in self._replicas.values()
             ]
         views = []
         for rid, state, inflight, eng in reps:
@@ -472,7 +509,11 @@ class FleetRouter:
                         "every routable replica shed the request"
                     )
                 raise EngineUnavailable("no routable replica")
-            r = self._replicas[view.rid]
+            with self._lock:
+                r = self._replicas.get(view.rid)
+            if r is None:  # retired between the view and the placement
+                exclude.add(view.rid)
+                continue
             remaining = (
                 None if freq.deadline is None
                 else freq.deadline - self._clock()
@@ -547,6 +588,7 @@ class FleetRouter:
                         self._completed += 1
                         if att.is_hedge:
                             self._hedge_wins += 1
+                    self._count_outcome("completed")
         # Span I/O after the latch: a file write between sub completion
         # and latching would widen the window in which the watcher sees
         # a done-but-unlatched attempt.
@@ -562,7 +604,7 @@ class FleetRouter:
         if self.hedge_after is None:
             return None
         if self.hedge_after == "auto":
-            for r in self._replicas:
+            for r in self._reps():
                 if r.state in ROUTABLE and r.engine is not None:
                     return auto_hedge_delay(r.engine.estimates.snapshot())
             return None
@@ -583,6 +625,7 @@ class FleetRouter:
                     ):
                         with self._lock:
                             self._failed += 1
+                        self._count_outcome("failed")
                     return
                 waits = [self.supervisor_poll]
                 if freq.deadline is not None:
@@ -638,6 +681,7 @@ class FleetRouter:
                     ):
                         with self._lock:
                             self._failed += 1
+                        self._count_outcome("failed")
                     return
                 if (
                     hedge_at is not None
@@ -698,7 +742,7 @@ class FleetRouter:
 
     def _supervise(self) -> None:
         while not self._stop_event.wait(self.supervisor_poll):
-            for r in self._replicas:
+            for r in self._reps():
                 with self._lock:
                     state = r.state
                     rebuilding = r.rebuilding
@@ -734,10 +778,12 @@ class FleetRouter:
                     r.rebuild_thread = t
                     t.start()
 
-    def _rebuild(self, r: _Replica) -> None:
-        """Background re-warmup of a quarantined replica: fresh engine
-        from the factory, warmed, aligned to the fleet's current weight
-        generation, then reinstated READY."""
+    def _rebuild(self, r: _Replica, reinstate: bool = True) -> None:
+        """Background (re-)warmup of a replica slot: fresh engine from
+        the factory, warmed, aligned to the fleet's current weight
+        generation, then put in rotation READY.  ``reinstate=False`` is
+        the add_replica path — same machinery, counted and journaled as
+        growth instead of recovery."""
         try:
             if self._stopped:
                 return  # fleet went away before the build even began
@@ -748,17 +794,21 @@ class FleetRouter:
             if weights is not None and gen > 0:
                 eng.swap_weights(weights, generation=gen)
             with self._lock:
-                if self._stopped:
-                    pass  # fleet went away mid-rebuild; discard below
+                if self._stopped or self._replicas.get(r.rid) is not r \
+                        or r.state == RETIRING:
+                    pass  # fleet/slot went away mid-build; discard below
                 else:
                     r.engine = eng
                     r.state = READY
                     r.fail_streak = 0
-                    self._reinstatements += 1
+                    if reinstate:
+                        self._reinstatements += 1
+                    else:
+                        self._added += 1
                     eng = None
             if eng is not None:
                 eng.stop(drain=False)
-            else:
+            elif reinstate:
                 obs.emit(
                     "serve", "fleet_reinstate", {"replica": r.rid},
                     logger=log,
@@ -766,11 +816,119 @@ class FleetRouter:
                 obs.counter(
                     "fleet_reinstatements_total", "replica reinstatements"
                 ).inc()
+            else:
+                obs.emit("serve", "fleet_replica_added", {
+                    "replica": r.rid, "generation": gen,
+                }, logger=log)
+                obs.counter(
+                    "fleet_replicas_added_total",
+                    "replicas added by scale-up",
+                ).inc()
         except Exception:
-            log.exception("fleet: rebuild of replica %d failed", r.rid)
+            log.exception("fleet: build of replica %d failed", r.rid)
         finally:
             with self._lock:
                 r.rebuilding = False
+
+    # -- dynamic fleet (autoscaler API) ------------------------------------
+
+    def add_replica(self, wait: bool = False,
+                    timeout: float = 300.0) -> int:
+        """Grow the fleet by one replica on a fresh, never-reused rid.
+
+        The build runs in the BACKGROUND on the rebuild machinery
+        (factory → start/warmup → align to the current weight
+        generation → READY), so the call returns immediately with the
+        new rid; ``wait=True`` blocks until the replica is in rotation
+        (raises TimeoutError if the build does not land in time).
+        """
+        with self._lock:
+            if self._stopped or self._draining:
+                raise EngineUnavailable("fleet stopping")
+            rid = self._next_rid
+            self._next_rid += 1
+            r = _Replica(rid)
+            r.rebuilding = True  # keeps the supervisor's hands off
+            self._replicas[rid] = r
+        t = threading.Thread(
+            target=self._rebuild, args=(r, False),
+            name=f"fleet-add-{rid}", daemon=True,
+        )
+        r.rebuild_thread = t
+        t.start()
+        if wait:
+            deadline = self._clock() + timeout
+            while self._clock() < deadline:
+                with self._lock:
+                    if r.state in ROUTABLE:
+                        return rid
+                    gone = self._replicas.get(rid) is not r
+                if gone or (not t.is_alive() and r.state not in ROUTABLE):
+                    raise EngineUnavailable(
+                        f"replica {rid} build failed"
+                    )
+                time.sleep(0.02)
+            raise TimeoutError(f"replica {rid} not ready in {timeout}s")
+        return rid
+
+    def retire_replica(self, rid: int, timeout: float = 60.0,
+                       reason: str = "scale-down") -> bool:
+        """Shrink the fleet by draining one replica out of rotation:
+        stop admitting (state RETIRING excludes it from every routing
+        view), let its accepted work finish (the engine drains its own
+        queue; fleet-side attempts complete through their callbacks),
+        then release the slot.  Returns True when the drain was clean —
+        zero accepted requests lost, the same bar as ``replica_kill``.
+
+        Refuses (ValueError) to retire the last routable replica: an
+        autoscaler bug must not be able to scale the fleet to zero.
+        """
+        with self._lock:
+            r = self._replicas.get(rid)
+            if r is None:
+                raise KeyError(f"no replica {rid} in the fleet")
+            if r.state == RETIRING:
+                return False
+            routable_n = sum(
+                1 for x in self._replicas.values()
+                if x.state in ROUTABLE
+            )
+            if r.state in ROUTABLE and routable_n <= 1 \
+                    and not self._stopped:
+                raise ValueError(
+                    "refusing to retire the last routable replica"
+                )
+            r.state = RETIRING
+        eng = r.engine
+        clean = True
+        if eng is not None:
+            try:
+                # Drain: the engine finishes every accepted request
+                # before its worker exits; nothing new lands because
+                # RETIRING is not ROUTABLE.
+                eng.stop(timeout=timeout, drain=True)
+            except Exception:
+                log.exception("draining replica %d failed", rid)
+                clean = False
+        # Wait out fleet-side completion callbacks for this replica.
+        deadline = self._clock() + max(1.0, timeout)
+        while self._clock() < deadline:
+            with self._lock:
+                if r.inflight == 0:
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            clean = clean and r.inflight == 0
+            self._replicas.pop(rid, None)
+            self._retired += 1
+        obs.emit("serve", "fleet_replica_retired", {
+            "replica": rid, "reason": reason,
+        }, logger=log)
+        obs.counter(
+            "fleet_replicas_retired_total",
+            "replicas retired by scale-down",
+        ).inc()
+        return clean
 
 
 def build_fleet(

@@ -18,6 +18,14 @@ knows it was killed):
                     v
                   DEAD                     (rebuild budget exhausted)
 
+    READY/DEGRADED ----> RETIRING ----> (removed)   (scale-down drain:
+                                        stop admitting, drain accepted
+                                        work, release the device slot)
+
+The replica-id space is SPARSE under autoscaling: retire_replica leaves
+a hole and add_replica appends a fresh never-reused rid, so every policy
+function here treats rids as opaque labels, never as list indices.
+
 Routing policy: least-loaded first.  Load is ``inflight +
 queue_depth`` — work accepted but not finished — with READY preferred
 over DEGRADED at equal load, and the replica id as the deterministic
@@ -35,10 +43,12 @@ READY = "ready"
 DEGRADED = "degraded"
 QUARANTINED = "quarantined"
 DEAD = "dead"
+RETIRING = "retiring"
 
 # States a request may be routed to.  QUARANTINED replicas are fenced
-# (their engine was killed; a rebuild is in flight) and DEAD ones are
-# gone for good.
+# (their engine was killed; a rebuild is in flight), RETIRING ones are
+# draining toward removal (accepted work finishes, nothing new lands),
+# and DEAD ones are gone for good.
 ROUTABLE = frozenset({READY, DEGRADED})
 
 
@@ -92,6 +102,23 @@ def select_hedge(
     request already runs on — a hedge onto the wedged replica is not a
     hedge."""
     return select_replica(views, bucket=bucket, exclude=tried)
+
+
+def routable_views(
+    views: Sequence[ReplicaView],
+) -> list[ReplicaView]:
+    """The subset a request could land on right now (rid-sparse safe)."""
+    return [v for v in views if v.state in ROUTABLE]
+
+
+def mean_load(views: Sequence[ReplicaView]) -> float:
+    """Mean accepted-but-unfinished work per routable replica — the
+    autoscaler's primary pressure signal (ctrl/autoscale.py).  0.0 with
+    no routable replica (the supervisor's problem, not a load signal)."""
+    r = routable_views(views)
+    if not r:
+        return 0.0
+    return sum(v.inflight + v.queue_depth for v in r) / len(r)
 
 
 def auto_hedge_delay(

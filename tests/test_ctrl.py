@@ -1,0 +1,566 @@
+"""Control-plane tests (docs/autoscaling.md).
+
+The closed loop in four layers, cheapest first: the pure scaling policy
+(:func:`~mx_rcnn_tpu.ctrl.desired_action` over frozen signals), the
+burn-rate engine on SYNTHETIC journals (fires on a step-change error
+rate, clears on recovery, replays identically from ``metrics_flush``
+records), the :class:`~mx_rcnn_tpu.ctrl.Autoscaler` loop against a fake
+fleet (scale-up immediate under queue pressure, scale-down only after
+dwell + cooldown), the dynamic-fleet API on a REAL
+:class:`~mx_rcnn_tpu.serve.FleetRouter` over fake-runner engines
+(add/retire under load loses zero accepted requests; rids stay sparse
+and never reused) — and then the whole rehearsal: tools/soak.py in
+``--fake-engines`` mode as a real subprocess, asserting the SLO verdict
+line and the BENCH_soak record.  tools/chaos.py's ``fleet_scale``
+scenario repeats the resize story with real engines on fake devices.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.config import CtrlConfig, get_config
+from mx_rcnn_tpu.ctrl import (
+    SLO,
+    Autoscaler,
+    ScalePolicy,
+    ScaleSignals,
+    SLOEngine,
+    build_controller,
+    default_slos,
+    desired_action,
+    good_total,
+    merged_percentile,
+)
+from mx_rcnn_tpu.serve import InferenceEngine
+from mx_rcnn_tpu.serve import router as router_mod
+
+from test_serve import FakeRunner, _fleet, _img, _wait  # noqa: F401
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _avail_snap(completed: float, failed: float = 0.0,
+                shed: float = 0.0) -> dict:
+    return {"fleet_requests_total": {
+        'outcome="completed"': float(completed),
+        'outcome="failed"': float(failed),
+        'outcome="shed"': float(shed),
+    }}
+
+
+def _lat_snap(counts, le=(0.1, 1.0, 10.0)) -> dict:
+    total = sum(counts)
+    return {"serve_request_latency_seconds": {
+        'level="full"': {
+            "count": float(total), "sum": 1.0,
+            "le": list(le), "buckets": [float(c) for c in counts],
+        },
+    }}
+
+
+# ---------------------------------------------------------------------------
+# SLO objects + evaluation helpers
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLO("x", target=1.5)
+        with pytest.raises(ValueError):
+            SLO("x", target=0.9, kind="nope")
+        with pytest.raises(ValueError):
+            SLO("x", target=0.9, kind="latency")  # no threshold
+
+    def test_availability_counts_shed_as_bad(self):
+        slo = SLO("availability", target=0.99)
+        good, total = good_total(slo, _avail_snap(90, failed=4, shed=6))
+        assert (good, total) == (90.0, 100.0)
+
+    def test_latency_good_is_under_threshold(self):
+        slo = SLO("lat", target=0.9, kind="latency", threshold_s=1.0)
+        good, total = good_total(slo, _lat_snap([70, 25, 5]))
+        # buckets <= 1.0s are good: 70 + 25
+        assert (good, total) == (95.0, 100.0)
+
+    def test_latency_level_filter(self):
+        slo = SLO("lat", target=0.9, kind="latency", threshold_s=1.0,
+                  level="reduced")
+        good, total = good_total(slo, _lat_snap([70, 25, 5]))
+        assert total == 0.0  # only level="full" series present
+
+    def test_merged_percentile(self):
+        snap = _lat_snap([90, 9, 1])
+        assert merged_percentile(snap, 0.5) == pytest.approx(0.1)
+        assert merged_percentile(snap, 0.99) == pytest.approx(1.0)
+        assert merged_percentile({}, 0.99) is None
+
+    def test_default_slos_from_config(self):
+        slos = default_slos(CtrlConfig())
+        assert [s.kind for s in slos] == ["availability", "latency"]
+        assert slos[1].threshold_s == CtrlConfig().latency_threshold_s
+
+
+# ---------------------------------------------------------------------------
+# burn-rate engine on synthetic journals
+# ---------------------------------------------------------------------------
+
+
+def _journal(series):
+    """[(t, completed, failed)] -> metrics_flush journal records."""
+    return [
+        {"kind": "metrics_flush", "ts": t,
+         "payload": {"snapshot": _avail_snap(c, f)}}
+        for t, c, f in series
+    ]
+
+
+def _incident_series(fast_s=300.0):
+    """An hour healthy, then a 10%-failure step, then recovery."""
+    series, t, c, f = [], 0.0, 0, 0
+    for _ in range(12):                       # healthy history
+        c += 100
+        series.append((t, c, f))
+        t += fast_s
+    incident_start = len(series)
+    for _ in range(4):                        # incident: 10% failing
+        c += 90
+        f += 10
+        series.append((t, c, f))
+        t += fast_s
+    incident_end = len(series)
+    for _ in range(4):                        # recovery
+        c += 100
+        series.append((t, c, f))
+        t += fast_s
+    return series, incident_start, incident_end
+
+
+class TestBurnEngine:
+    def test_fires_on_incident_clears_on_recovery(self):
+        series, i0, i1 = _incident_series()
+        eng = SLOEngine([SLO("availability", target=0.99)],
+                        fast_s=300, slow_s=3600, burn_factor=2.0)
+        fired_at = cleared_at = None
+        for i, (t, c, f) in enumerate(series):
+            st = eng.observe(t, _avail_snap(c, f))
+            if st["availability"]["firing"] and fired_at is None:
+                fired_at = i
+            if fired_at is not None and cleared_at is None \
+                    and not st["availability"]["firing"]:
+                cleared_at = i
+        assert fired_at is not None and i0 <= fired_at < i1
+        assert cleared_at is not None and cleared_at >= i1
+        events = [a["event"] for a in eng.alerts]
+        assert events == ["start", "stop"]
+
+    def test_healthy_run_never_fires(self):
+        eng = SLOEngine([SLO("availability", target=0.99)],
+                        fast_s=300, slow_s=3600)
+        t, c = 0.0, 0
+        for _ in range(20):
+            c += 100
+            st = eng.observe(t, _avail_snap(c))
+            assert not st["availability"]["firing"]
+            t += 300
+        assert eng.alerts == []
+        v = eng.verdicts()[0]
+        assert v["held"] and v["burn_alerts"] == 0
+
+    def test_short_blip_does_not_trip_slow_window(self):
+        # One bad flush inside an otherwise-clean hour: the fast window
+        # spikes but the slow window stays under factor -> no alert.
+        eng = SLOEngine([SLO("availability", target=0.99)],
+                        fast_s=300, slow_s=3600, burn_factor=14.0)
+        t, c, f = 0.0, 0, 0
+        for i in range(14):
+            if i == 12:
+                c, f = c + 80, f + 20
+            else:
+                c += 100
+            st = eng.observe(t, _avail_snap(c, f))
+            assert not st["availability"]["firing"], (i, st)
+            t += 300
+        assert eng.alerts == []
+
+    def test_replay_matches_live(self):
+        series, _, _ = _incident_series()
+        live = SLOEngine([SLO("availability", target=0.99)],
+                         fast_s=300, slow_s=3600)
+        for t, c, f in series:
+            live.observe(t, _avail_snap(c, f))
+        replayed = SLOEngine([SLO("availability", target=0.99)],
+                             fast_s=300, slow_s=3600)
+        replayed.replay(_journal(series))
+        assert [a["event"] for a in replayed.alerts] == \
+            [a["event"] for a in live.alerts]
+        assert replayed.verdicts() == live.verdicts()
+
+    def test_burn_events_reach_the_journal(self, tmp_path):
+        obs.configure(str(tmp_path), flush_s=3600)
+        series, _, _ = _incident_series()
+        eng = SLOEngine([SLO("availability", target=0.99)],
+                        fast_s=300, slow_s=3600)
+        for t, c, f in series:
+            eng.observe(t, _avail_snap(c, f))
+        obs.close()
+        kinds = [r["kind"] for r in obs.read_journal(
+            str(tmp_path / "journal.jsonl")
+        )]
+        assert "slo_burn_start" in kinds and "slo_burn_stop" in kinds
+
+    def test_budget_gauge_exported(self):
+        eng = SLOEngine([SLO("availability", target=0.99)],
+                        fast_s=300, slow_s=3600)
+        eng.observe(0.0, _avail_snap(100))
+        eng.observe(300.0, _avail_snap(150, 50))
+        snap = obs.registry().snapshot()
+        series = snap["slo_error_budget_remaining"]
+        assert series['{slo="availability"}'] < 0  # budget blown
+
+    def test_verdict_held_tracks_whole_run_budget(self):
+        eng = SLOEngine([SLO("availability", target=0.9)],
+                        fast_s=10, slow_s=20)
+        eng.observe(0.0, _avail_snap(0))
+        eng.observe(10.0, _avail_snap(95, 5))   # 5% bad < 10% budget
+        v = eng.verdicts()[0]
+        assert v["held"] and v["budget_remaining"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# pure scaling policy
+# ---------------------------------------------------------------------------
+
+
+def _sig(routable=2, building=0, mean_load=0.0, queue_depth=0,
+         shed_rate=0.0, p99_s=None):
+    return ScaleSignals(routable, building, mean_load, queue_depth,
+                        shed_rate, p99_s)
+
+
+class TestDesiredAction:
+    POL = ScalePolicy(min_replicas=1, max_replicas=4, load_high=4.0,
+                      load_low=0.5, down_dwell=2)
+
+    def test_queue_pressure_scales_up(self):
+        a, r = desired_action(_sig(mean_load=6.0), self.POL)
+        assert a == "up" and "mean load" in r
+
+    def test_shed_is_pressure(self):
+        a, r = desired_action(_sig(mean_load=0.1, shed_rate=1.0), self.POL)
+        assert a == "up" and "shed" in r
+
+    def test_p99_signal_opt_in(self):
+        pol = ScalePolicy(p99_high_s=0.5)
+        a, r = desired_action(_sig(mean_load=1.0, p99_s=0.9), pol)
+        assert a == "up" and "p99" in r
+        # disabled by default: same signals, stock policy -> hold
+        a, _ = desired_action(_sig(mean_load=1.0, p99_s=0.9), self.POL)
+        assert a == "hold"
+
+    def test_max_replicas_caps_up(self):
+        a, r = desired_action(_sig(routable=4, mean_load=9.0), self.POL)
+        assert a == "hold" and "max_replicas" in r
+
+    def test_building_counts_toward_size_cap(self):
+        a, r = desired_action(
+            _sig(routable=3, building=1, mean_load=9.0), self.POL
+        )
+        assert a == "hold" and "max_replicas" in r
+
+    def test_comfort_scales_down(self):
+        a, _ = desired_action(_sig(mean_load=0.1), self.POL)
+        assert a == "down"
+
+    def test_min_replicas_floors_down(self):
+        a, _ = desired_action(_sig(routable=1, mean_load=0.0), self.POL)
+        assert a == "hold"
+
+    def test_no_down_while_building(self):
+        a, _ = desired_action(_sig(building=1, mean_load=0.1), self.POL)
+        assert a == "hold"
+
+    def test_from_config_roundtrip(self):
+        pol = ScalePolicy.from_config(CtrlConfig(max_replicas=11))
+        assert pol.max_replicas == 11
+
+
+# ---------------------------------------------------------------------------
+# autoscaler loop against a fake fleet
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedFleet:
+    """stats()-shaped fake whose load the test scripts directly."""
+
+    def __init__(self, inflight):
+        self.reps = dict(inflight)          # rid -> inflight
+        self.next_rid = max(self.reps) + 1
+        self.adds: list = []
+        self.retires: list = []
+
+    def stats(self):
+        return {"shed": 0, "replica": [
+            {"rid": rid, "state": "ready", "inflight": n,
+             "engine": {"queue_depth": 0}}
+            for rid, n in self.reps.items()
+        ]}
+
+    def add_replica(self, wait=False, timeout=300.0):
+        rid = self.next_rid
+        self.next_rid += 1
+        self.reps[rid] = 0
+        self.adds.append(rid)
+        return rid
+
+    def retire_replica(self, rid, timeout=60.0, reason=""):
+        del self.reps[rid]
+        self.retires.append(rid)
+        return True
+
+
+class TestAutoscaler:
+    def _scaler(self, fleet, clk, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("load_high", 4.0)
+        kw.setdefault("load_low", 0.5)
+        kw.setdefault("down_dwell", 3)
+        kw.setdefault("up_cooldown_s", 5.0)
+        kw.setdefault("down_cooldown_s", 10.0)
+        return Autoscaler(fleet, ScalePolicy(**kw), clock=lambda: clk[0])
+
+    def test_scale_up_is_immediate_then_cooldown_gated(self):
+        fl = _ScriptedFleet({0: 5, 1: 5})
+        clk = [0.0]
+        sc = self._scaler(fl, clk)
+        assert sc.step()["action"] == "up"
+        assert fl.adds == [2]
+        fl.reps = {rid: 6 for rid in fl.reps}   # pressure persists
+        clk[0] = 1.0
+        rec = sc.step()                          # inside cooldown
+        assert rec["action"] == "hold" and "cooldown" in rec["reason"]
+        assert fl.adds == [2]
+        clk[0] = 7.0
+        assert sc.step()["action"] == "up"       # cooldown expired
+        assert fl.adds == [2, 3]
+
+    def test_scale_down_needs_dwell_and_retires_newest(self):
+        fl = _ScriptedFleet({0: 0, 1: 0, 2: 0})
+        clk = [100.0]
+        sc = self._scaler(fl, clk)
+        r1, r2, r3 = sc.step(), sc.step(), sc.step()
+        assert [r["action"] for r in (r1, r2)] == ["hold", "hold"]
+        assert (r1["dwell"], r2["dwell"]) == (1, 2)
+        assert r3["action"] == "down" and fl.retires == [2]
+        # dwell resets + down-cooldown: the next step cannot retire
+        assert sc.step()["action"] == "hold"
+
+    def test_pressure_resets_dwell(self):
+        fl = _ScriptedFleet({0: 0, 1: 0})
+        clk = [100.0]
+        sc = self._scaler(fl, clk, down_dwell=2)
+        assert sc.step()["dwell"] == 1
+        fl.reps = {0: 9, 1: 9}                  # burst interrupts comfort
+        clk[0] = 101.0
+        assert sc.step()["action"] == "up"
+        fl.reps = {rid: 0 for rid in fl.reps}
+        clk[0] = 150.0
+        assert sc.step()["dwell"] == 1          # streak restarted
+
+    def test_decisions_carry_signals(self):
+        fl = _ScriptedFleet({0: 9, 1: 9})
+        sc = self._scaler(fl, [0.0])
+        sc.step()
+        (d,) = sc.resize_timeline()
+        assert d["action"] == "up"
+        assert d["signals"]["mean_load"] == pytest.approx(9.0)
+        assert d["reason"]
+
+    def test_journal_and_gauge(self, tmp_path):
+        obs.configure(str(tmp_path), flush_s=3600)
+        fl = _ScriptedFleet({0: 9, 1: 9})
+        sc = self._scaler(fl, [0.0])
+        sc.step()
+        obs.close()
+        recs = obs.read_journal(str(tmp_path / "journal.jsonl"))
+        ups = [r for r in recs if r["kind"] == "fleet_scale_up"]
+        assert ups and ups[0]["payload"]["signals"]["mean_load"] == 9.0
+        assert obs.registry().snapshot()["ctrl_fleet_size"][""] == 2.0
+
+    def test_build_controller_wires_config(self):
+        cfg = get_config("tiny_synthetic")
+        eng, sc = build_controller(cfg, _ScriptedFleet({0: 0}))
+        assert eng.fast_s == cfg.ctrl.burn_fast_s
+        assert sc.policy.max_replicas == cfg.ctrl.max_replicas
+
+
+# ---------------------------------------------------------------------------
+# dynamic fleet on a real FleetRouter (fake-runner engines)
+# ---------------------------------------------------------------------------
+
+
+class TestDynamicFleet:
+    def test_add_replica_joins_rotation(self):
+        fleet, _ = _fleet(2, runner_fn=lambda rid: FakeRunner(delay=0.01))
+        with fleet:
+            rid = fleet.add_replica(wait=True, timeout=30)
+            assert rid == 2
+            s = fleet.stats()
+            assert s["replicas"] == 3 and s["added"] == 1
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(9)]
+            res = [r.result(10) for r in reqs]
+            assert {r["replica_id"] for r in res} >= {2}
+
+    def test_retire_drains_accepted_work(self):
+        fleet, _ = _fleet(3, runner_fn=lambda rid: FakeRunner(delay=0.05))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(12)]
+            clean = fleet.retire_replica(2, timeout=30)
+            assert clean
+            res = [r.result(10) for r in reqs]
+            assert len(res) == 12
+            s = fleet.stats()
+            assert s["failed"] == 0
+            assert s["replicas"] == 2 and s["retired"] == 1
+            assert sorted(rep["rid"] for rep in s["replica"]) == [0, 1]
+
+    def test_rids_sparse_and_never_reused(self):
+        fleet, _ = _fleet(3, runner_fn=lambda rid: FakeRunner(delay=0.005))
+        with fleet:
+            fleet.retire_replica(1, timeout=30)
+            rid = fleet.add_replica(wait=True, timeout=30)
+            assert rid == 3  # not the freed 1
+            rids = sorted(
+                rep["rid"] for rep in fleet.stats()["replica"]
+            )
+            assert rids == [0, 2, 3]
+            # traffic still routes across the sparse id space
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(9)]
+            used = {r.result(10)["replica_id"] for r in reqs}
+            assert used <= {0, 2, 3} and len(used) == 3
+
+    def test_retire_last_routable_refused(self):
+        fleet, _ = _fleet(1, runner_fn=lambda rid: FakeRunner())
+        with fleet:
+            with pytest.raises(ValueError):
+                fleet.retire_replica(0)
+        # after stop() the guard no longer applies — nothing to protect
+
+    def test_retire_unknown_rid_raises(self):
+        fleet, _ = _fleet(2, runner_fn=lambda rid: FakeRunner())
+        with fleet:
+            with pytest.raises(KeyError):
+                fleet.retire_replica(7)
+
+    def test_add_then_kill_interleave_loses_nothing(self):
+        # Scale-up while a replica dies: the two supervisor paths
+        # (rebuild-reinstate and add-build) coexist without losing work.
+        fleet, _ = _fleet(2, runner_fn=lambda rid: FakeRunner(delay=0.02))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=15) for _ in range(8)]
+            fleet.add_replica()
+            fleet.kill_replica(0, "test interleave")
+            reqs += [fleet.submit(_img(8, 8), timeout=15) for _ in range(8)]
+            res = [r.result(15) for r in reqs]
+            assert len(res) == 16
+            s = fleet.stats()
+            assert s["failed"] == 0
+            _wait(lambda: fleet.stats()["replicas"] == 3)
+            _wait(lambda: fleet.stats()["reinstatements"] >= 1)
+
+    def test_fleet_outcome_counters(self):
+        fleet, _ = _fleet(2, runner_fn=lambda rid: FakeRunner(delay=0.005))
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(6)]
+            [r.result(10) for r in reqs]
+        snap = obs.registry().snapshot()
+        assert snap["fleet_requests_total"]['{outcome="completed"}'] == 6.0
+
+    def test_autoscaler_drives_real_fleet(self):
+        # End-to-end without subprocesses: block the workers so queue
+        # pressure is unambiguous, step -> add; release, drain, idle
+        # steps -> dwell -> retire of the added rid.
+        gate = threading.Event()
+        fleet, _ = _fleet(
+            2, runner_fn=lambda rid: FakeRunner(block=gate),
+            hang_timeout=60.0, quarantine_failures=100,
+        )
+        clk = [0.0]
+        sc = Autoscaler(
+            fleet,
+            ScalePolicy(min_replicas=2, max_replicas=3, load_high=1.0,
+                        load_low=0.5, down_dwell=2, up_cooldown_s=0.0,
+                        down_cooldown_s=0.0),
+            clock=lambda: clk[0],
+        )
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=30) for _ in range(8)]
+            rec = sc.step()
+            assert rec["action"] == "up", rec
+            new_rid = rec["replica"]
+            assert new_rid == 2
+            gate.set()
+            res = [r.result(30) for r in reqs]
+            assert len(res) == 8
+            _wait(lambda: any(
+                rep["rid"] == new_rid and rep["state"] == router_mod.READY
+                for rep in fleet.stats()["replica"]
+            ), timeout=30)
+            down = None
+            for i in range(10):
+                clk[0] += 1.0
+                rec = sc.step()
+                if rec["action"] == "down":
+                    down = rec
+                    break
+            assert down is not None and down["replica"] == new_rid
+            s = fleet.stats()
+            assert s["failed"] == 0 and s["added"] == 1 \
+                and s["retired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the rehearsal: fake-engine soak as a real subprocess
+# ---------------------------------------------------------------------------
+
+
+class TestSoakSmoke:
+    def test_fake_engine_soak_holds_slos(self, tmp_path):
+        """CPU-only rehearsal in seconds: diurnal+spike traffic, a
+        mid-run replica kill, the autoscaler live — SLOs must hold and
+        the BENCH_soak record must carry verdicts + resize timeline."""
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "soak.py"),
+             "--fake-engines", "--duration", "8", "--qps", "30",
+             "--service-time", "0.01", "--deadline", "20",
+             "--ctrl-period", "0.25",
+             "--obs-dir", str(tmp_path / "obs")],
+            capture_output=True, text=True, timeout=60, cwd=REPO_ROOT,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "[soak] SLO VERDICT: HELD" in out.stderr, out.stderr
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["bench"] == "soak" and rec["pass"]
+        assert rec["failed"] == 0 and rec["completed"] > 0
+        assert rec["killed_rid"] is not None
+        assert rec["quarantines"] >= 1
+        verdicts = {v["slo"]: v for v in rec["slo"]["verdicts"]}
+        assert set(verdicts) == {"availability", "latency"}
+        assert all(v["held"] for v in verdicts.values())
+        assert "full" in rec["latency_by_level"]
+        for d in rec["resize_timeline"]:
+            assert d["action"] in ("up", "down") and "signals" in d

@@ -251,6 +251,90 @@ class TestMetrics:
         assert sum(s["count"] for s in snap.values()) == n_threads * n_ops
 
 
+class TestSnapshotWindow:
+    def test_delta_over_window(self):
+        from mx_rcnn_tpu.obs.metrics import SnapshotWindow
+
+        c = obs.counter("t_win_total")
+        w = SnapshotWindow(obs.registry())
+        c.inc(5)
+        w.observe(0.0)
+        c.inc(3)
+        w.observe(10.0)
+        c.inc(2)
+        w.observe(20.0)
+        dt, delta = w.delta_over(10.0)
+        assert dt == pytest.approx(10.0)
+        assert delta["t_win_total"][""] == 2.0
+        dt, delta = w.delta_over(100.0)  # longer than history: oldest
+        assert dt == pytest.approx(20.0)
+        assert delta["t_win_total"][""] == 5.0
+
+    def test_histogram_delta_recomputes_percentiles(self):
+        from mx_rcnn_tpu.obs.metrics import SnapshotWindow
+
+        h = obs.histogram("t_win_lat", buckets=(0.1, 1.0))
+        w = SnapshotWindow(obs.registry())
+        for _ in range(100):
+            h.observe(0.05)      # old history: all fast
+        w.observe(0.0)
+        for _ in range(10):
+            h.observe(0.5)       # window: all slow
+        w.observe(10.0)
+        _, delta = w.delta_over(10.0)
+        summ = delta["t_win_lat"][""]
+        assert summ["count"] == 10
+        # Cumulative p99 would say 0.1; the windowed delta must not.
+        assert summ["p99"] == pytest.approx(1.0)
+
+    def test_counter_reset_clamps_not_negative(self):
+        from mx_rcnn_tpu.obs.metrics import snapshot_delta
+
+        older = {"t_x_total": {"": 100.0}}
+        newer = {"t_x_total": {"": 7.0}}   # process restarted
+        delta = snapshot_delta(older, newer)
+        assert delta["t_x_total"][""] == 7.0
+
+    def test_horizon_bounds_history(self):
+        from mx_rcnn_tpu.obs.metrics import SnapshotWindow
+
+        w = SnapshotWindow(obs.registry(), horizon_s=50.0)
+        for t in range(0, 200, 10):
+            w.observe(float(t))
+        assert w.span_s() <= 50.0
+
+    def test_hammer_observe_vs_delta(self):
+        from mx_rcnn_tpu.obs.metrics import SnapshotWindow
+
+        c = obs.counter("t_win_hammer_total")
+        w = SnapshotWindow(obs.registry())
+        stop = threading.Event()
+        errors: list = []
+
+        def reader():
+            t = 0.0
+            while not stop.is_set():
+                try:
+                    w.observe(t)
+                    w.delta_over(5.0)
+                    w.rate("t_win_hammer_total", window_s=5.0)
+                except Exception as e:  # noqa: BLE001 - collected
+                    errors.append(e)
+                    return
+                t += 0.1
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(2000):
+            c.inc()
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert c.value() == 2000.0
+
+
 # ---------------------------------------------------------------------------
 # /metrics endpoint
 # ---------------------------------------------------------------------------

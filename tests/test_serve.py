@@ -540,6 +540,47 @@ class TestRouterPolicy:
             {"reduced": 0.001}, floor=0.05
         ) == pytest.approx(0.05)
 
+    def test_sparse_rids_are_opaque_labels(self):
+        # Autoscaled fleets leave holes (retire) and grow past the
+        # original range (add): routing must never index by rid.
+        views = [_view(0, inflight=3), _view(5, inflight=1),
+                 _view(12, inflight=2)]
+        assert router_mod.select_replica(views).rid == 5
+        assert router_mod.select_replica(
+            views, exclude=frozenset({5})
+        ).rid == 12
+
+    def test_retiring_is_not_routable(self):
+        views = [_view(0, state=router_mod.RETIRING),
+                 _view(3, inflight=9)]
+        assert router_mod.select_replica(views).rid == 3
+        views = [_view(0, state=router_mod.RETIRING)]
+        assert router_mod.select_replica(views) is None
+
+    def test_hedge_selection_on_sparse_rids(self):
+        views = [_view(2, inflight=0), _view(7, inflight=1),
+                 _view(9, state=router_mod.RETIRING)]
+        # Primary runs on 2; the hedge must pick fresh, routable metal.
+        got = router_mod.select_hedge(views, tried=frozenset({2}))
+        assert got.rid == 7
+        assert router_mod.select_hedge(
+            views, tried=frozenset({2, 7})
+        ) is None  # RETIRING never hedges
+
+    def test_routable_views_and_mean_load(self):
+        views = [_view(1, inflight=2, qd=2),
+                 _view(4, state=router_mod.RETIRING, inflight=9),
+                 _view(6, state=router_mod.DEGRADED, inflight=1, qd=1),
+                 _view(8, state=router_mod.QUARANTINED)]
+        routable = router_mod.routable_views(views)
+        assert [v.rid for v in routable] == [1, 6]
+        # (2+2 + 1+1) / 2 — RETIRING/QUARANTINED load is excluded.
+        assert router_mod.mean_load(views) == pytest.approx(3.0)
+        assert router_mod.mean_load([]) == 0.0
+        assert router_mod.mean_load(
+            [_view(0, state=router_mod.DEAD)]
+        ) == 0.0
+
 
 def _fleet(n=3, runner_fn=None, hang_timeout=5.0, **kw):
     runners = {}

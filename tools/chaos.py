@@ -76,6 +76,11 @@ Fleet scenarios (serve/fleet.py) — real fleets on 4 fake CPU devices
                   every accepted request completes, and the process
                   exits RESUMABLE_EXIT_CODE (75) — the trainer's
                   preemption contract, applied to serving.
+  fleet_scale     autoscaler closed loop (ctrl/autoscale.py): a queue
+                  spike forces a scale-up onto a spare device, idleness
+                  dwells into a scale-down drain, zero accepted
+                  requests lost — and the full resize story replays
+                  from the obs journal.
 
 Bit-identity holds because recovery re-runs the same compiled program
 over the same data schedule from the same restored state — it is the
@@ -553,6 +558,88 @@ def child_fleet_drain_main() -> int:
     assert clean, "drain left pending requests behind"
     assert failed == 0, f"{failed} accepted requests failed during drain"
     return RESUMABLE_EXIT_CODE
+
+
+def child_fleet_scale_main() -> int:
+    """Autoscaler closed loop on a real fleet: a queue spike forces a
+    scale-up (background build joins the rotation), idleness then walks
+    the dwell counter to a scale-down (drain + slot release) — with
+    zero accepted requests lost across both resizes."""
+    _fleet_cpu(4)
+    import numpy as np
+    from mx_rcnn_tpu import obs
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.ctrl import Autoscaler, ScalePolicy
+    from mx_rcnn_tpu.serve import build_fleet
+
+    obs_dir = os.environ.get("MX_RCNN_OBS_DIR")
+    if obs_dir:
+        obs.configure(obs_dir)
+
+    cfg = get_config(CONFIG)
+    variables = _init_variables(cfg, seed=0)
+    img = np.random.default_rng(0).uniform(
+        0, 255, (100, 100, 3)
+    ).astype(np.float32)
+    fleet = build_fleet(
+        cfg, variables, n_replicas=2,
+        engine_kwargs={"hang_timeout": 300.0, "max_queue": 64},
+        supervisor_poll=0.1,
+    )
+    # Tight thresholds so a 12-request burst is unambiguous pressure
+    # and an idle fleet is unambiguous comfort; no cooldowns, so the
+    # test drives the dwell logic alone.
+    scaler = Autoscaler(fleet, ScalePolicy(
+        min_replicas=2, max_replicas=3,
+        load_high=1.0, load_low=0.5,
+        down_dwell=2, up_cooldown_s=0.0, down_cooldown_s=0.0,
+    ))
+    with fleet:
+        accepted = [fleet.submit(img, timeout=300) for _ in range(12)]
+        rec_up = scaler.step()
+        assert rec_up["action"] == "up", rec_up
+        new_rid = rec_up["replica"]
+        # The new replica builds in the background (warmup compiles)
+        # while the burst keeps serving; wait until it joins rotation.
+        wait_for(
+            lambda: any(
+                rep["rid"] == new_rid
+                and rep["state"] in ("ready", "degraded")
+                for rep in fleet.stats()["replica"]
+            ),
+            300,
+        )
+        # Traffic lands on the grown fleet too.
+        accepted += [fleet.submit(img, timeout=300) for _ in range(4)]
+        results = [r.result(timeout=300) for r in accepted]
+        # Idle now: the dwell counter must walk to a scale-down.
+        rec_down = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            rec = scaler.step()
+            if rec["action"] == "down":
+                rec_down = rec
+                break
+            time.sleep(0.2)
+        s = fleet.stats()
+    assert rec_down is not None, "autoscaler never scaled down"
+    assert rec_down["replica"] == new_rid, rec_down
+    assert rec_down.get("clean", False), f"retire drain unclean: {rec_down}"
+    print(json.dumps({
+        "accepted": len(accepted), "completed": len(results),
+        "failed": s["failed"], "added": s["added"],
+        "retired": s["retired"], "replicas_final": s["replicas"],
+        "scaled_up_rid": new_rid,
+        "up_reason": rec_up["reason"], "down_reason": rec_down["reason"],
+        "decisions": len(scaler.resize_timeline()),
+    }))
+    assert len(results) == len(accepted), "an accepted request was lost"
+    assert s["failed"] == 0, f"accepted requests failed: {s}"
+    assert s["added"] >= 1 and s["retired"] >= 1, s
+    assert s["replicas"] == 2, s
+    if obs_dir:
+        obs.close()
+    return 0
 
 
 def compare_main(dir_a: str, dir_b: str) -> int:
@@ -1260,6 +1347,34 @@ def scenario_fleet_drain(root: str, steps: int, timeout: float) -> dict:
     return r
 
 
+def scenario_fleet_scale(root: str, steps: int, timeout: float) -> dict:
+    # Journal enabled: beyond the child's zero-loss assertions, the
+    # scenario proves the whole resize story — decision, build, join,
+    # dwell, retire — reconstructs from the obs artifacts alone.
+    obs_dir = os.path.join(root, "fleet_scale", "obs")
+    r = _json_child(root, "fleet_scale", "--child-fleet-scale", timeout,
+                    env={"MX_RCNN_OBS_DIR": obs_dir})
+    assert r["failed"] == 0 and r["completed"] == r["accepted"], r
+    assert r["added"] >= 1 and r["retired"] >= 1, r
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    report, _ = obs_report.build_report(obs_dir)
+    tl = [e["kind"] for e in report["incident_timeline"]]
+    for kind in ("fleet_scale_up", "fleet_replica_added",
+                 "fleet_scale_down", "fleet_replica_retired"):
+        assert kind in tl, tl
+    assert tl.index("fleet_scale_up") < tl.index("fleet_scale_down"), tl
+    assert tl.index("fleet_replica_added") < tl.index(
+        "fleet_replica_retired"
+    ), tl
+    r["obs_events"] = report["journal_records"]
+    return r
+
+
 SCENARIOS = {
     "baseline": scenario_baseline,
     "sigkill": scenario_sigkill,
@@ -1278,6 +1393,7 @@ SCENARIOS = {
     "replica_wedge": scenario_replica_wedge,
     "swap_under_load": scenario_swap_under_load,
     "fleet_drain": scenario_fleet_drain,
+    "fleet_scale": scenario_fleet_scale,
 }
 
 # Scenarios that restore/compare against baseline's checkpoint.
@@ -1309,6 +1425,8 @@ def main(argv=None) -> int:
         return child_swap_main()
     if argv and argv[0] == "--child-fleet-drain":
         return child_fleet_drain_main()
+    if argv and argv[0] == "--child-fleet-scale":
+        return child_fleet_scale_main()
     if argv and argv[0] == "--compare":
         return compare_main(argv[1], argv[2])
 

@@ -1,12 +1,23 @@
 """Open-loop load generator for the serving fleet (BENCH_serving).
 
 Drives a real :class:`~mx_rcnn_tpu.serve.fleet.FleetRouter` (tiny model,
-random params, hermetic CPU with one fake device per replica) at a fixed
-arrival rate for a fixed duration and reports the latency distribution
-over *completed* requests plus the fleet's own counters.  Open-loop
-means arrivals are scheduled on the wall clock, not gated on responses —
-a slow fleet falls behind and the backlog shows up as shed requests and
-a fat tail, exactly like production.
+random params, hermetic CPU with one fake device per replica) for a
+fixed duration and reports the latency distribution over *completed*
+requests plus the fleet's own counters.  Open-loop means arrivals are
+scheduled on the wall clock, not gated on responses — a slow fleet falls
+behind and the backlog shows up as shed requests and a fat tail, exactly
+like production.
+
+The arrival rate follows a ``--profile`` (shared with tools/soak.py via
+:func:`make_profile`):
+
+* ``constant`` — ``--qps`` throughout (the default; unchanged behavior).
+* ``sine`` — a compressed diurnal curve: ``qps * (1 + amplitude *
+  sin(2*pi*t/period))``, so the fleet sees a trough and a peak every
+  ``--period`` seconds.
+* ``spike`` — ``--qps`` baseline with a burst of ``qps *
+  spike-factor`` for the first ``--duty`` fraction of every
+  ``--period`` seconds: the autoscaler-rehearsal shape.
 
 Optionally (``--kill-one``) a replica is killed at the midpoint, which
 exercises quarantine -> rebuild -> reinstatement *under load*: the bench
@@ -32,12 +43,51 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
 import time
+from typing import Callable
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROFILES = ("constant", "sine", "spike")
+
+
+def make_profile(
+    name: str,
+    qps: float,
+    *,
+    amplitude: float = 0.5,
+    period_s: float = 60.0,
+    spike_factor: float = 4.0,
+    duty: float = 0.15,
+) -> Callable[[float], float]:
+    """Arrival-rate schedule ``rate(t_elapsed) -> req/s``.
+
+    Shared by the loadgen CLI and the soak harness so both rehearse the
+    same traffic shapes.  Rates are floored at a small positive value —
+    an open loop with rate exactly 0 would never schedule the next
+    arrival and the clock math below divides by it.
+    """
+    if qps <= 0:
+        raise ValueError("qps must be > 0")
+    if name == "constant":
+        return lambda t: qps
+    if name == "sine":
+        if not 0.0 <= amplitude <= 1.0:
+            raise ValueError("amplitude must be in [0, 1]")
+        return lambda t: max(
+            0.05, qps * (1.0 + amplitude * math.sin(2 * math.pi * t / period_s))
+        )
+    if name == "spike":
+        if not 0.0 < duty < 1.0:
+            raise ValueError("duty must be in (0, 1)")
+        return lambda t: (
+            qps * spike_factor if (t % period_s) < duty * period_s else qps
+        )
+    raise ValueError(f"unknown profile {name!r} (want one of {PROFILES})")
 
 
 def _hermetic_cpu(n_devices: int) -> None:
@@ -117,7 +167,11 @@ def run_bench(args: argparse.Namespace) -> dict:
             latencies.append(time.monotonic() - t_submit)
 
     killed_rid = None
-    interval = 1.0 / args.qps
+    rate = make_profile(
+        args.profile, args.qps,
+        amplitude=args.amplitude, period_s=args.period,
+        spike_factor=args.spike_factor, duty=args.duty,
+    )
     t0 = time.monotonic()
     next_at = t0
     deadline_wall = t0 + args.duration
@@ -130,8 +184,10 @@ def run_bench(args: argparse.Namespace) -> dict:
             continue
         # Open loop: the schedule advances whether or not this arrival
         # is admitted, so a slow fleet accumulates lateness (and sheds)
-        # instead of quietly throttling the offered load.
-        next_at += interval
+        # instead of quietly throttling the offered load.  The interval
+        # is re-derived from the profile each arrival, so sine/spike
+        # shapes modulate inter-arrival gaps, not batch sizes.
+        next_at += 1.0 / rate(now - t0)
         if args.kill_one and killed_rid is None and \
                 now - t0 >= args.duration / 2.0:
             killed_rid = 0
@@ -174,6 +230,7 @@ def run_bench(args: argparse.Namespace) -> dict:
         "bench": "serving",
         "replicas": args.replicas,
         "qps": args.qps,
+        "profile": args.profile,
         "duration_s": args.duration,
         "submitted": submitted,
         "completed": len(latencies),
@@ -220,7 +277,19 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=2)
     p.add_argument("--qps", type=float, default=6.0,
-                   help="open-loop arrival rate (requests/second)")
+                   help="open-loop arrival rate (requests/second); the "
+                        "baseline rate for non-constant profiles")
+    p.add_argument("--profile", choices=PROFILES, default="constant",
+                   help="traffic shape over the window (see module doc)")
+    p.add_argument("--amplitude", type=float, default=0.5,
+                   help="sine profile: fractional swing around --qps")
+    p.add_argument("--period", type=float, default=60.0,
+                   help="sine/spike profile: cycle length in seconds")
+    p.add_argument("--spike-factor", type=float, default=4.0,
+                   help="spike profile: burst rate as a multiple of --qps")
+    p.add_argument("--duty", type=float, default=0.15,
+                   help="spike profile: fraction of each period spent "
+                        "bursting")
     p.add_argument("--duration", type=float, default=15.0,
                    help="load window in seconds")
     p.add_argument("--deadline", type=float, default=120.0,

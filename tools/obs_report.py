@@ -13,8 +13,12 @@ Outputs:
 
 * ``artifacts/obs_report.json`` (``--out``) — counts per event kind, the
   reconstructed **incident timeline** (kill -> detect -> quarantine/reap
-  -> rebuild/respawn -> recover, in journal order), flight-dump summaries
-  and any stage/headline lines.
+  -> rebuild/respawn -> recover, in journal order), an **slo** section
+  (error-budget timeline from the ``slo_error_budget_remaining`` gauge
+  in ``metrics_flush`` snapshots, burn alerts, and the autoscaler's
+  resize decisions with their input signals — why the fleet changed
+  size, from the journal alone), flight-dump summaries and any
+  stage/headline lines.
 * ``<obs-dir>/trace.json`` (``--trace-out``) — the span lines wrapped in
   a Chrome-trace ``{"traceEvents": [...]}`` array, loadable in Perfetto
   next to the jax.profiler dumps.
@@ -46,7 +50,52 @@ INCIDENT_KINDS = frozenset({
     "engine_dead", "engine_killed",
     "fleet_quarantine", "fleet_reinstate", "fleet_retire", "weight_swap",
     "breaker_transition", "ladder_transition",
+    "slo_burn_start", "slo_burn_stop",
+    "fleet_scale_up", "fleet_scale_down",
+    "fleet_replica_added", "fleet_replica_retired",
 })
+
+
+def _slo_section(journal: list[dict], t0: float) -> dict:
+    """Control-plane story from the journal alone: the error-budget
+    trajectory (every ``metrics_flush`` snapshot carries the
+    ``slo_error_budget_remaining{slo=...}`` gauge), burn-alert
+    transitions, and the fleet-resize decisions with the signals the
+    autoscaler acted on."""
+    budget_timeline: list[dict] = []
+    burn_alerts: list[dict] = []
+    resize_decisions: list[dict] = []
+    for rec in journal:
+        kind = rec.get("kind")
+        payload = rec.get("payload") or {}
+        t_s = round(rec.get("ts", t0) - t0, 3)
+        if kind == "metrics_flush":
+            series = (payload.get("snapshot") or {}).get(
+                "slo_error_budget_remaining"
+            )
+            if isinstance(series, dict) and series:
+                point = {"t_s": t_s}
+                for label, v in series.items():
+                    # label is 'slo="availability"' — keep just the value.
+                    name = label.split('"')[1] if '"' in label else label
+                    point[name] = round(v, 6) if isinstance(v, float) else v
+                budget_timeline.append(point)
+        elif kind in ("slo_burn_start", "slo_burn_stop"):
+            burn_alerts.append({
+                "t_s": t_s, "event": kind.rsplit("_", 1)[-1],
+                **{k: v for k, v in payload.items()},
+            })
+        elif kind in ("fleet_scale_up", "fleet_scale_down"):
+            resize_decisions.append({
+                "t_s": t_s,
+                "direction": kind.rsplit("_", 1)[-1],
+                **{k: v for k, v in payload.items()},
+            })
+    return {
+        "budget_timeline": budget_timeline,
+        "burn_alerts": burn_alerts,
+        "resize_decisions": resize_decisions,
+    }
 
 
 def _read_jsonl(path: str) -> list[dict]:
@@ -136,6 +185,7 @@ def build_report(
         "journal_records": len(journal),
         "events_by_kind": dict(sorted(events_by_kind.items())),
         "incident_timeline": timeline,
+        "slo": _slo_section(journal, t0),
         "spans": {
             "count": len(spans),
             "traces": len(traces),

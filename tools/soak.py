@@ -1,322 +1,457 @@
-"""Long-run training soak on real hardware (VERDICT r4 #1).
+"""Serving soak: diurnal + spike traffic, live chaos, SLO verdicts.
 
-Trains the flagship R50-FPN at the recipe canvas (800x1344) on synthetic
-uint8 data for thousands of optimizer steps — through warmup and two
-lr-decay boundaries, with a mid-run stop + checkpoint resume — then
-evaluates the final state.  This exercises exactly the paths no short
-bench or test touches as one continuous run (the reference's analog is
-``MutableModule.fit``'s epoch loop over a real schedule, SURVEY.md §3.7):
+The production rehearsal for the closed control loop (docs/autoscaling.md).
+One process runs, concurrently:
 
-- schedule dynamics at scale (warmup -> plateau -> two decays);
-- bf16 numerical stability over thousands of optimizer steps;
-- the checkpoint-every-N branch of the production train loop;
-- loader epoch wraparound under run_length grouping (hundreds of images,
-  many epochs);
-- resume continuity mid-run (phase B restores phase A's checkpoint and
-  fast-forwards the data schedule);
-- the train -> eval handoff at recipe resolution.
+* **traffic** — an open-loop arrival schedule composed from the shared
+  loadgen profiles (tools/loadgen.py::make_profile): a compressed
+  diurnal sine modulating the base rate, with periodic spike bursts
+  multiplied on top, so the fleet sees troughs, peaks and steps in a
+  single run;
+* **the control plane** — an :class:`~mx_rcnn_tpu.ctrl.SLOEngine`
+  evaluating availability + latency SLOs on soak-scaled burn windows,
+  and an :class:`~mx_rcnn_tpu.ctrl.Autoscaler` resizing the fleet
+  between ``--min-replicas`` and ``--max-replicas`` off queue/shed/p99
+  pressure;
+* **chaos** — a replica kill at mid-run (quarantine -> rebuild under
+  load), and optionally (``--data-chaos``) a data-path chaos scenario
+  (cache corruption + decode-worker kill) as concurrent subprocesses,
+  rehearsing the input service failing while serving burns.
 
-The dataset is the 81-class synthetic renderer in uint8 form, so the
-trained program is bit-for-bit the flagship r50_fpn_coco train step
-(same class count, same canvas, same dtype path as real COCO training).
-Since r4 the renderer uses the "wheel" palette (all 80 classes visually
-distinct); the first r4 soak ran the "classic" ramp, whose color
-saturation above class ~8 capped absolute AP at 0.128 by construction.
-The gates are "loss decreased substantially", "every logged metric
-finite", "lr boundaries visible", and "eval AP clears an
-untrained-model floor".
+Verdict: the run PASSES only if every SLO held (whole-run error budget
+not exhausted) and no accepted request was lost.  Prints
+``[soak] SLO VERDICT: HELD`` (or ``VIOLATED``) on stderr and exactly
+one ``BENCH_soak`` JSON record as the LAST stdout line, carrying the
+per-SLO verdicts, the autoscaler's resize-decision timeline (with the
+input signals for every decision) and a per-degrade-level latency
+summary.
 
-Usage:  python tools/soak.py [--steps 3000] [--resume-at 1600]
-                             [--images 400] [--workdir runs/soak]
-Prints one JSON summary line on stdout; diagnostics on stderr.
+Two engine modes:
+
+* default — real :func:`~mx_rcnn_tpu.serve.fleet.build_fleet` engines
+  (tiny model, hermetic CPU, one fake device per ``--max-replicas``);
+* ``--fake-engines`` — a runner-protocol fake with a configurable
+  service time, no model build: the shape of the rehearsal in seconds,
+  used by tests/test_ctrl.py and the CI ``soak_smoke`` job.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/soak.py --duration 60 --qps 8
+    python tools/soak.py --fake-engines --duration 12 --qps 40
+
+(The training-side endurance run lives in tools/train_soak.py.)
 """
 
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
-import math
 import os
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools.loadgen import _hermetic_cpu, _percentile, make_profile
 
-def build_soak_config(steps: int, workdir: str, preset: str = "r50_fpn_coco"):
-    from mx_rcnn_tpu.config import ScheduleConfig, get_config
 
-    cfg = get_config(preset)
-    # Absolute step schedule (reference_batch=0: no epoch rescale — the
-    # soak pins exact boundaries) with warmup and two decays inside the
-    # run.  lr still scales by global_batch/16 = 2/16, i.e. base 0.02 ->
-    # 0.0025 at per-chip batch 2, the linear-scaling value real training
-    # would use on one chip.
-    sched = ScheduleConfig(
-        base_lr=0.02,
-        warmup_steps=500,
-        warmup_factor=1.0 / 3.0,
-        decay_steps=(steps // 2, steps * 5 // 6),
-        factor=0.1,
-        total_steps=steps,
-        reference_batch=0,
+class _SoakRunner:
+    """Runner-protocol fake with a fixed service time (no JAX, no
+    model).  Mirrors tests/test_serve.py::FakeRunner — kept separate so
+    the tool never imports the test suite."""
+
+    def __init__(self, delay: float, buckets=((64, 64),)):
+        self.buckets = sorted(
+            (tuple(b) for b in buckets), key=lambda b: b[0] * b[1]
+        )
+        self.batch_size = 1
+        self.delay = delay
+        self.generation = 0
+        self._warmed = set()
+
+    def levels(self):
+        return ("full", "reduced", "proposals")
+
+    def pick_bucket(self, h, w):
+        for b in self.buckets:
+            if b[0] >= h and b[1] >= w:
+                return b
+        return self.buckets[-1]
+
+    def smaller_bucket(self, bucket):
+        i = self.buckets.index(tuple(bucket))
+        return self.buckets[i - 1] if i > 0 else None
+
+    def warmup(self):
+        for b in self.buckets:
+            for mode in ("full", "reduced", "proposals"):
+                self._warmed.add((mode, b))
+        return len(self._warmed)
+
+    def swap_weights(self, variables, generation=None):
+        gen = self.generation + 1 if generation is None else int(generation)
+        self.generation = gen
+        return gen
+
+    def run(self, mode, bucket, images):
+        import numpy as np
+
+        assert (mode, tuple(bucket)) in self._warmed
+        time.sleep(self.delay)
+        return [
+            {
+                "boxes": np.zeros((0, 4), np.float32),
+                "scores": np.zeros(0, np.float32),
+                "classes": np.zeros(0, np.int32),
+                "generation": self.generation,
+            }
+            for _ in images
+        ]
+
+
+def _build_fake_fleet(args):
+    from mx_rcnn_tpu.serve import FleetRouter, InferenceEngine
+
+    def factory(rid: int) -> InferenceEngine:
+        return InferenceEngine(
+            _SoakRunner(args.service_time),
+            replica_id=rid,
+            hang_timeout=60.0,
+            max_queue=args.max_queue,
+        )
+
+    return FleetRouter(
+        factory, args.replicas,
+        supervisor_poll=0.05, hedge_after=None,
     )
-    return dataclasses.replace(
-        cfg,
-        name=f"{preset}_soak",
-        workdir=workdir,
-        data=dataclasses.replace(cfg.data, dataset="synthetic", max_gt_boxes=32),
-        train=dataclasses.replace(
-            cfg.train,
-            per_device_batch=2,
-            steps_per_call=10,
-            schedule=sched,
-            checkpoint_every=1000,
-            log_every=20,
+
+
+def _build_real_fleet(args):
+    import jax
+
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+    from mx_rcnn_tpu.serve import build_fleet
+
+    cfg = get_config(args.config)
+    variables = init_detector(
+        TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
+        cfg.data.image_size,
+    )
+    return build_fleet(
+        cfg, variables, args.replicas,
+        engine_kwargs={"hang_timeout": 300.0, "max_queue": args.max_queue},
+        supervisor_poll=0.1,
+        hedge_after="auto",
+    )
+
+
+def _spawn_data_chaos(root: str) -> list[subprocess.Popen]:
+    """Data-path chaos concurrent with the serving soak: the input
+    service corrupting cache entries and losing decode workers while
+    the fleet is under load.  Each scenario is its own subprocess (the
+    chaos harness is self-contained); the soak only demands they PASS."""
+    procs = []
+    for scenario in ("cache_corrupt", "data_worker_kill"):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(root, "tools", "chaos.py"),
+             "--scenario", scenario],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=root,
+        ))
+    return procs
+
+
+def run_soak(args: argparse.Namespace) -> dict:
+    import numpy as np
+
+    from mx_rcnn_tpu import obs
+    from mx_rcnn_tpu.config import CtrlConfig
+    from mx_rcnn_tpu.ctrl import (
+        Autoscaler,
+        ScalePolicy,
+        SLOEngine,
+        default_slos,
+    )
+    from mx_rcnn_tpu.serve import Overloaded, ServeError
+
+    obs.configure(args.obs_dir, flush_s=max(args.ctrl_period, 0.5))
+    print(f"[soak] obs: run_id={obs.run_id()} dir={obs.out_dir()}",
+          file=sys.stderr)
+
+    fleet = (_build_fake_fleet if args.fake_engines
+             else _build_real_fleet)(args)
+    mode = "fake" if args.fake_engines else "real"
+    print(f"[soak] starting {args.replicas} {mode} replica(s)...",
+          file=sys.stderr)
+    fleet.start()
+    obs.register_status("fleet", fleet.stats)
+    print("[soak] fleet ready", file=sys.stderr)
+
+    # Burn windows scaled to the run so a soak-length incident can trip
+    # both windows: minutes-long fast/slow windows would never fire in
+    # a CI-sized rehearsal.
+    fast_s = max(2.0, args.duration * 0.1)
+    slow_s = max(fast_s, args.duration * 0.4)
+    ctrl = CtrlConfig(
+        availability_target=args.availability_target,
+        latency_target=args.latency_target,
+        latency_threshold_s=args.latency_threshold,
+    )
+    slo_engine = SLOEngine(
+        default_slos(ctrl), fast_s=fast_s, slow_s=slow_s,
+        burn_factor=args.burn_factor,
+    ).start(args.ctrl_period)
+    scaler = Autoscaler(
+        fleet,
+        ScalePolicy(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            load_high=args.load_high,
+            load_low=args.load_low,
+            down_dwell=args.down_dwell,
+            up_cooldown_s=args.up_cooldown,
+            down_cooldown_s=args.down_cooldown,
         ),
+        p99_window_s=max(fast_s, 5.0),
+    ).start(args.ctrl_period)
+
+    # Diurnal sine modulated by spike bursts: base * burst-multiplier.
+    base = make_profile(
+        "sine", args.qps, amplitude=args.amplitude,
+        period_s=args.duration / args.cycles,
+    )
+    burst = make_profile(
+        "spike", 1.0, spike_factor=args.spike_factor,
+        period_s=args.duration / args.cycles, duty=args.duty,
     )
 
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (48, 48, 3), dtype=np.uint8) \
+        if not args.fake_engines else np.zeros((48, 48, 3), np.float32)
 
-def make_roidb(cfg, num_images: int, seed: int = 1):
-    from mx_rcnn_tpu.data import SyntheticDataset
+    lock = threading.Lock()
+    by_level: dict[str, list[float]] = {}
+    submitted = shed = failed = 0
+    pending: list[threading.Thread] = []
 
-    return SyntheticDataset(
-        num_images=num_images,
-        image_hw=cfg.data.image_size,
-        num_classes=cfg.model.num_classes,
-        max_objects=8,
-        seed=seed,
-        dtype="uint8",
-        # All 80 classes visually distinct (golden-ratio hue + texture
-        # combos) — the classic ramp saturates above class ~8 and capped
-        # the r4 soak's absolute AP at 0.128 by renderer design, not by
-        # anything the detector did.
-        palette="wheel",
-    ).roidb()
+    def collect(freq, t_submit: float) -> None:
+        nonlocal failed
+        try:
+            res = freq.result(timeout=args.deadline + 60.0)
+        except ServeError:
+            with lock:
+                failed += 1
+            return
+        lat = time.monotonic() - t_submit
+        level = res.get("level", "full")
+        with lock:
+            by_level.setdefault(level, []).append(lat)
 
+    chaos_procs: list[subprocess.Popen] = []
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.data_chaos:
+        chaos_procs = _spawn_data_chaos(root)
+        print(f"[soak] data chaos: {len(chaos_procs)} scenario "
+              f"subprocess(es) running", file=sys.stderr)
 
-def make_loader(cfg, roidb, batch_size: int):
-    from mx_rcnn_tpu.data import DetectionLoader
+    killed_rid = None
+    t0 = time.monotonic()
+    next_at = t0
+    deadline_wall = t0 + args.duration
+    while True:
+        now = time.monotonic()
+        if now >= deadline_wall:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.02))
+            continue
+        t = now - t0
+        next_at += 1.0 / (base(t) * burst(t))
+        if args.kill_replica and killed_rid is None \
+                and t >= args.duration * 0.4:
+            # Kill a currently-routable replica (rids are sparse under
+            # autoscaling, so pick from live stats, not range()).
+            live = [rep["rid"] for rep in fleet.stats()["replica"]
+                    if rep["state"] in ("ready", "degraded")]
+            if live:
+                killed_rid = min(live)
+                fleet.kill_replica(killed_rid, "soak chaos")
+                print(f"[soak] killed replica {killed_rid} at "
+                      f"t={t:.1f}s", file=sys.stderr)
+        try:
+            freq = fleet.submit(img, timeout=args.deadline)
+        except Overloaded:
+            with lock:
+                submitted += 1
+                shed += 1
+            continue
+        except ServeError:
+            with lock:
+                submitted += 1
+                failed += 1
+            continue
+        with lock:
+            submitted += 1
+        th = threading.Thread(target=collect, args=(freq, now), daemon=True)
+        th.start()
+        pending.append(th)
 
-    return DetectionLoader(
-        roidb,
-        cfg.data,
-        batch_size=batch_size,
-        train=True,
-        seed=cfg.train.seed,
-        run_length=max(cfg.train.steps_per_call, 1),
-        # Mask presets need gt masks rasterized (the synthetic roidb
-        # carries octagon polygons) — same wiring train/loop.py uses.
-        with_masks=cfg.model.mask.enabled,
-    )
+    print(f"[soak] load window done ({submitted} arrivals); draining...",
+          file=sys.stderr)
+    for th in pending:
+        th.join(timeout=args.deadline + 120.0)
+    scaler.stop()
+    slo_engine.stop()   # runs a final observe() so verdicts cover the tail
+    stats = fleet.stats()
+    fleet.stop(timeout=240.0)
 
+    chaos = None
+    if chaos_procs:
+        chaos = []
+        for p in chaos_procs:
+            out, _ = p.communicate(timeout=600)
+            last = [ln for ln in out.splitlines() if ln.strip()]
+            chaos.append({
+                "cmd": p.args[-1],
+                "rc": p.returncode,
+                "tail": last[-1] if last else "",
+            })
+            print(f"[soak] data chaos {p.args[-1]}: rc={p.returncode}",
+                  file=sys.stderr)
 
-def final_eval(cfg, state, roidb):
-    """Evaluate the trained state over a slice of the soak set (train-set
-    AP: the learning signal the soak gates on).  Mirrors run_eval's body
-    with an explicit loader because build_dataset's synthetic default is
-    the 5-class float set, not the soak's 81-class uint8 one."""
-    import jax
-
-    from mx_rcnn_tpu.data import DetectionLoader
-    from mx_rcnn_tpu.detection import TwoStageDetector
-    from mx_rcnn_tpu.evalutil import pred_eval
-    from mx_rcnn_tpu.parallel.step import eval_variables, make_eval_step
-
-    model = TwoStageDetector(cfg=cfg.model)
-    eval_step = make_eval_step(
-        model, mesh=None,
-        pixel_stats=(cfg.data.pixel_mean, cfg.data.pixel_std),
-    )
-    variables = jax.device_put(eval_variables(jax.device_get(state)))
-    loader = DetectionLoader(
-        roidb, cfg.data,
-        batch_size=max(cfg.model.test.per_device_batch, 1),
-        train=False,
-    )
-    return pred_eval(
-        eval_step, variables, loader, roidb, cfg.model.num_classes,
-        style="coco",
-    )
-
-
-def summarize_metrics(path: str, decay_steps) -> dict:
-    """Parse metrics.jsonl: finiteness, loss trajectory, lr boundaries."""
-    rows = []
-    with open(path) as f:
-        for line in f:
-            rows.append(json.loads(line))
-    assert rows, f"{path} is empty"
-    nonfinite = []
-    for r in rows:
-        for k, v in r.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                nonfinite.append((r.get("step"), k, v))
-    by_step = {r["step"]: r for r in rows}
-
-    def lr_near(step, side):
-        """lr at the last log <= step (side=before) / first > (after)."""
-        steps_logged = sorted(by_step)
-        cands = [s for s in steps_logged if (s <= step if side == "before" else s > step)]
-        if not cands:
-            return None
-        s = cands[-1] if side == "before" else cands[0]
-        return by_step[s].get("lr")
-
-    losses = [r["loss"] for r in rows if "loss" in r]
-    k = max(len(losses) // 20, 1)
-    return {
-        "logged_rows": len(rows),
-        "nonfinite_count": len(nonfinite),
-        "nonfinite_first": nonfinite[:3],
-        "first_loss": losses[0],
-        "mean_first_5pct": sum(losses[:k]) / k,
-        "mean_last_5pct": sum(losses[-k:]) / k,
-        "last_loss": losses[-1],
-        "lr_around_decays": {
-            str(d): (lr_near(d, "before"), lr_near(d, "after"))
-            for d in decay_steps
+    verdicts = slo_engine.verdicts()
+    completed = sum(len(v) for v in by_level.values())
+    latency_by_level = {}
+    for level, vals in sorted(by_level.items()):
+        vals.sort()
+        latency_by_level[level] = {
+            "n": len(vals),
+            "p50_s": round(_percentile(vals, 0.50), 4),
+            "p99_s": round(_percentile(vals, 0.99), 4),
+            "max_s": round(vals[-1], 4),
+        }
+    rec = {
+        "bench": "soak",
+        "engine_mode": mode,
+        "duration_s": args.duration,
+        "profile": {
+            "base": "sine", "burst": "spike", "qps": args.qps,
+            "amplitude": args.amplitude, "cycles": args.cycles,
+            "spike_factor": args.spike_factor, "duty": args.duty,
         },
+        "replicas_initial": args.replicas,
+        "replicas_final": stats["replicas"],
+        "added": stats["added"],
+        "retired": stats["retired"],
+        "submitted": submitted,
+        "completed": completed,
+        "shed": shed,
+        "failed": failed,
+        "killed_rid": killed_rid,
+        "quarantines": stats["quarantines"],
+        "reinstatements": stats["reinstatements"],
+        "latency_by_level": latency_by_level,
+        "slo": {
+            "fast_s": round(fast_s, 2),
+            "slow_s": round(slow_s, 2),
+            "burn_factor": args.burn_factor,
+            "verdicts": verdicts,
+            "burn_alerts": [
+                {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in a.items()}
+                for a in slo_engine.alerts
+            ],
+        },
+        "resize_timeline": [
+            {**d, "t": round(d["t"] - t0, 2)}
+            for d in scaler.resize_timeline()
+        ],
+        "data_chaos": chaos,
+        "obs": {"run_id": obs.run_id(), "dir": obs.out_dir()},
     }
+    obs.close()
+    return rec
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--steps", type=int, default=3000)
-    ap.add_argument(
-        "--resume-at", type=int, default=1600,
-        help="stop phase A here; phase B restores the checkpoint and "
-        "continues to --steps (0 disables the resume exercise)",
-    )
-    ap.add_argument("--images", type=int, default=400)
-    ap.add_argument("--workdir", default="runs/soak")
-    ap.add_argument("--eval-images", type=int, default=96)
-    ap.add_argument(
-        "--config", default="r50_fpn_coco",
-        help="config preset to soak (e.g. mask_r50_fpn_coco — the mask "
-        "branch then trains and checkpoints through the whole run)",
-    )
-    args = ap.parse_args()
-    if args.resume_at and not 0 < args.resume_at < args.steps:
-        # Catch this up front: phase A training past the schedule would
-        # only surface as an assert after the whole run's chip time.
-        ap.error(
-            f"--resume-at {args.resume_at} must lie strictly inside "
-            f"(0, --steps {args.steps}); pass --resume-at 0 to disable "
-            "the resume exercise"
-        )
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--duration", type=float, default=45.0)
+    p.add_argument("--qps", type=float, default=8.0,
+                   help="diurnal baseline arrival rate")
+    p.add_argument("--amplitude", type=float, default=0.5,
+                   help="diurnal swing as a fraction of --qps")
+    p.add_argument("--cycles", type=float, default=2.0,
+                   help="diurnal cycles across the run")
+    p.add_argument("--spike-factor", type=float, default=3.0,
+                   help="burst multiplier on the diurnal rate")
+    p.add_argument("--duty", type=float, default=0.15,
+                   help="fraction of each cycle spent bursting")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="fleet size at t=0")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--load-high", type=float, default=3.0)
+    p.add_argument("--load-low", type=float, default=0.5)
+    p.add_argument("--down-dwell", type=int, default=3)
+    p.add_argument("--up-cooldown", type=float, default=3.0)
+    p.add_argument("--down-cooldown", type=float, default=8.0)
+    p.add_argument("--ctrl-period", type=float, default=0.5,
+                   help="control-loop evaluation period (seconds)")
+    p.add_argument("--availability-target", type=float, default=0.95)
+    p.add_argument("--latency-target", type=float, default=0.95)
+    p.add_argument("--latency-threshold", type=float, default=30.0,
+                   help="latency SLO: good means under this (seconds)")
+    p.add_argument("--burn-factor", type=float, default=3.0)
+    p.add_argument("--deadline", type=float, default=120.0)
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--config", default="tiny_synthetic")
+    p.add_argument("--fake-engines", action="store_true",
+                   help="runner-protocol fakes instead of real models "
+                        "(seconds-scale; used by tests and CI smoke)")
+    p.add_argument("--service-time", type=float, default=0.01,
+                   help="--fake-engines: per-request service time")
+    p.add_argument("--kill-replica", action="store_true", default=True)
+    p.add_argument("--no-kill-replica", dest="kill_replica",
+                   action="store_false",
+                   help="skip the mid-run replica kill")
+    p.add_argument("--data-chaos", action="store_true",
+                   help="run cache-corruption + decode-worker-kill "
+                        "chaos scenarios as concurrent subprocesses")
+    p.add_argument("--obs-dir", default=None,
+                   help="obs journal/spans dir (default: a temp dir)")
+    args = p.parse_args(argv)
+    if args.obs_dir is None:
+        import tempfile
 
-    import jax
+        args.obs_dir = tempfile.mkdtemp(prefix="soak_obs_")
+    if not args.fake_engines:
+        _hermetic_cpu(args.max_replicas)
 
-    # Same persistent compile cache as bench.py: repeat soak invocations
-    # (smoke run, then the real run) skip the multi-minute step compile.
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(repo, ".jax_cache")
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+    rec = run_soak(args)
 
-    from mx_rcnn_tpu.cli.common import setup_logging
-    from mx_rcnn_tpu.train.loop import train
-
-    setup_logging(True)
-    cfg = build_soak_config(args.steps, args.workdir, preset=args.config)
-    # A previous run's checkpoints would hijack phase B's resume (it
-    # restores the LATEST step — a stale step-3000 checkpoint makes phase
-    # B a no-op and the PASS gate score the old params).  Refuse rather
-    # than silently wipe.
-    from mx_rcnn_tpu.train.checkpoint import latest_step
-
-    ckpt_dir = os.path.join(args.workdir, cfg.name, "ckpt")
-    stale = latest_step(ckpt_dir)
-    if stale is not None:
-        raise SystemExit(
-            f"{ckpt_dir} already holds a run (latest step {stale}); delete "
-            "it or pass a fresh --workdir — phase B's resume would restore "
-            "it instead of this run's phase A"
-        )
-    global_batch = cfg.train.per_device_batch  # single chip
-    t0 = time.perf_counter()
-    print(
-        f"rendering {args.images} synthetic {cfg.data.image_size} uint8 "
-        f"images ({cfg.model.num_classes} classes)...",
-        file=sys.stderr,
-    )
-    roidb = make_roidb(cfg, args.images)
-    print(f"rendered in {time.perf_counter() - t0:.0f}s", file=sys.stderr)
-
-    epochs = args.steps * global_batch / args.images
-    print(
-        f"soak: {args.steps} steps x batch {global_batch} over "
-        f"{args.images} images = {epochs:.1f} epochs; decays at "
-        f"{cfg.train.schedule.decay_steps}, resume exercise at "
-        f"{args.resume_at}, checkpoints every "
-        f"{cfg.train.checkpoint_every}",
-        file=sys.stderr,
-    )
-
-    t_train0 = time.perf_counter()
-    if args.resume_at:
-        train(
-            cfg, total_steps=args.resume_at, workdir=args.workdir,
-            loader=make_loader(cfg, roidb, global_batch),
-        )
-        print(
-            f"phase A done at step {args.resume_at} "
-            f"({time.perf_counter() - t_train0:.0f}s); resuming...",
-            file=sys.stderr,
-        )
-    state = train(
-        cfg, total_steps=args.steps, workdir=args.workdir, resume=True,
-        loader=make_loader(cfg, roidb, global_batch),
-    )
-    t_train = time.perf_counter() - t_train0
-    assert int(jax.device_get(state.step)) == args.steps
-
-    metrics = final_eval(cfg, state, roidb[: args.eval_images])
-    summary = summarize_metrics(
-        os.path.join(args.workdir, cfg.name, "metrics.jsonl"),
-        cfg.train.schedule.decay_steps,
-    )
-    ckpts = sorted(
-        os.listdir(os.path.join(args.workdir, cfg.name, "ckpt"))
-    )
-    out = {
-        "steps": args.steps,
-        "resume_at": args.resume_at,
-        "images": args.images,
-        "epochs": round(epochs, 1),
-        "train_seconds": round(t_train, 1),
-        "img_per_sec": round(args.steps * global_batch / t_train, 2),
-        "checkpoints": ckpts,
-        "eval": {k: round(float(v), 4) for k, v in metrics.items()},
-        **summary,
-    }
-    print(json.dumps(out))
-    # Loss gate against the FIRST logged loss, not the first-5% mean: the
-    # steepest descent happens inside the first log window (r4 run: 2.11
-    # at step 10, ~1.0 by step 150), so a windowed-mean ratio understates
-    # a perfectly healthy curve.  AP floor: see the inline rationale on
-    # the gate below (untrained is < 0.001).
-    ok = (
-        summary["nonfinite_count"] == 0
-        and summary["mean_last_5pct"] < 0.6 * summary["first_loss"]
-        # Wheel-palette floor: the r4b run read AP 0.556 (classic-ramp
-        # runs read 0.128 — renderer-capped); 0.25 catches a real
-        # learning regression without pinning a chaotic synthetic value.
-        and metrics.get("AP", 0.0) > 0.25
-        # Mask presets must also gate the mask head: a segm regression to
-        # zero with a healthy box head would otherwise still PASS.  Floor
-        # is below the r4b run's 0.2573 by the same margin logic as box.
-        and (
-            not cfg.model.mask.enabled
-            or metrics.get("segm/AP", 0.0) > 0.12
-        )
-    )
-    print(f"SOAK {'PASS' if ok else 'FAIL'}", file=sys.stderr)
-    sys.exit(0 if ok else 1)
+    held = all(v["held"] for v in rec["slo"]["verdicts"])
+    ok = held and rec["failed"] == 0 and rec["completed"] > 0
+    if args.data_chaos and rec["data_chaos"] is not None:
+        ok = ok and all(c["rc"] == 0 for c in rec["data_chaos"])
+    rec["held"] = held
+    rec["pass"] = ok
+    print(json.dumps(rec))
+    for v in rec["slo"]["verdicts"]:
+        print(f"[soak] slo {v['slo']}: budget_remaining="
+              f"{v['budget_remaining']:+.4f} worst_burn_fast="
+              f"{v['worst_burn_fast']} alerts={v['burn_alerts']} "
+              f"held={v['held']}", file=sys.stderr)
+    print(f"[soak] fleet resizes: +{rec['added']} -{rec['retired']} "
+          f"(final {rec['replicas_final']})", file=sys.stderr)
+    print(f"[soak] SLO VERDICT: {'HELD' if held else 'VIOLATED'}",
+          file=sys.stderr)
+    if not ok:
+        print(f"[soak] FAIL: held={held} failed={rec['failed']} "
+              f"completed={rec['completed']}", file=sys.stderr)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
