@@ -333,6 +333,20 @@ class DataConfig:
     # Per-worker-slot respawn budget after a death/wedge; exhausting every
     # slot degrades to in-process synchronous assembly (run completes).
     worker_respawns: int = 2
+    # Zero-copy shm transport for the input service (data/shm_ring.py):
+    # each worker ships assembled batches through a CRC-stamped
+    # shared-memory ring instead of pickling tensors through the result
+    # queue; bounded slots are the backpressure.  Only active when
+    # num_workers > 0.  shm_transport=False restores the pickle path.
+    shm_transport: bool = True
+    # Ring slots per worker.  Each slot holds one batch; more slots buy
+    # pipelining headroom at slots*slot_bytes shm per worker.
+    shm_slots: int = 4
+    # Slot size override in MiB.  0 (default) auto-sizes from the batch
+    # shape (canvas, max_gt_boxes, masks/proposals if on) with headroom;
+    # a batch that still overflows its slot falls back to pickle for that
+    # batch only.
+    shm_slot_mb: int = 0
 
 
 @dataclass(frozen=True)
@@ -468,6 +482,27 @@ class CtrlConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Serving-engine defaults consumed by serve/engine.py::build_engine
+    and serve/fleet.py::build_fleet (explicit kwargs still win)."""
+
+    # Static micro-batch slots per device call.  1 keeps the
+    # one-request-per-call path; >1 enables cross-request packing.
+    batch_size: int = 1
+    # Continuous batching (serve/batcher.py): pack pending requests from
+    # different callers into every bucket slot of each device call,
+    # deadline-aware.  De-interleaved responses are bitwise identical to
+    # the unpacked path (docs/serving.md).  Only meaningful when
+    # batch_size > 1.
+    pack: bool = True
+    # How long (seconds) the worker lingers for stragglers to top off a
+    # partially-filled batch before launching it.  0 launches whatever is
+    # packable immediately — lowest latency, occupancy rides on queue
+    # depth.
+    pack_window_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class Config:
     name: str = "faster_rcnn_r50_fpn_coco"
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -475,6 +510,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     ctrl: CtrlConfig = field(default_factory=CtrlConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     workdir: str = "runs"
 
 

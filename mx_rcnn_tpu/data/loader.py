@@ -719,12 +719,39 @@ class DetectionLoader:
             "quarantine_announced": sorted(self._quarantined),
         }
 
+    def _shm_slot_bytes(self) -> int:
+        """Auto-size one shm ring slot to the worst-case assembled batch:
+        float32 images (synthetic/normalized paths are 4x the uint8 fast
+        path) plus gt arrays, masks, and external proposals when on, with
+        25% headroom over the payload and the fixed header region on top.
+        An overflowing batch is not an error — it falls back to pickle for
+        that batch — so this is a throughput knob, not a correctness one."""
+        from mx_rcnn_tpu.data.shm_ring import HEADER_RESERVE
+
+        slot_mb = int(getattr(self.cfg, "shm_slot_mb", 0) or 0)
+        if slot_mb > 0:
+            return slot_mb * (1 << 20)
+        b = max(self.batch_size // self._world, 1)
+        h, w = self.cfg.image_size
+        g = self.cfg.max_gt_boxes
+        payload = b * h * w * 3 * 4          # images, float32 worst case
+        payload += b * (2 * 4)               # image_hw
+        payload += b * g * (4 * 4 + 4 + 1 + 1)  # boxes/classes/valid/ignore
+        if self.with_masks:
+            payload += b * g * GT_MASK_SIZE * GT_MASK_SIZE * 4
+        if self.proposals is not None:
+            payload += b * self.num_proposals * (4 * 4 + 1)
+        return int(payload * 1.25) + HEADER_RESERVE + 4096
+
     def _service_batches(self, spec_iter, start_index: int = 0):
         """Run a local spec stream through the process input service
         (data/service.py).  Yields in spec order; closing this generator
         (or exhausting it) tears the service down."""
         from mx_rcnn_tpu.data.service import InputService
 
+        shm_slots = 0
+        if getattr(self.cfg, "shm_transport", True):
+            shm_slots = max(int(getattr(self.cfg, "shm_slots", 4)), 0)
         svc = InputService(
             specs=spec_iter,
             assemble=self._assemble_rows,
@@ -733,6 +760,9 @@ class DetectionLoader:
             num_workers=self.service_workers,
             start_index=start_index,
             respawns=self.worker_respawns,
+            shm_slots=shm_slots,
+            shm_slot_bytes=self._shm_slot_bytes() if shm_slots else 0,
+            quarantine_path=self.quarantine_path,
         )
         try:
             yield from svc

@@ -42,10 +42,22 @@ Failure handling mirrors the serving fleet (serve/fleet.py):
   parent vanishes (a SIGKILLed parent can run no cleanup), so chaos
   kills never leak decode processes.
 
+- **Zero-copy shm transport** (``shm_slots > 0``) — each worker owns a
+  CRC-stamped shared-memory ring (data/shm_ring.py) and ships batches as
+  slot references instead of pickles; the consumer maps slots as numpy
+  views.  Bounded slots are the backpressure (a full ring blocks the
+  worker, heartbeating, counted as a stall); a corrupt/torn slot is
+  quarantined like a corrupt cache blob and its batch index reassigned,
+  so the yielded stream stays bit-identical.  Values the ring cannot
+  encode (or that overflow a slot) fall back to the pickle path
+  per-batch — the transport degrades, the schedule does not.
+
 Chaos hooks (tools/chaos.py, real-subprocess scenarios): workers
 self-SIGKILL or wedge on a claimed batch index; an ``O_EXCL`` sentinel
 file makes the claim exclusive, so the reassigned batch does not
-re-trigger the fault on the next worker.
+re-trigger the fault on the next worker.  ``MX_RCNN_CHAOS_SHM_CORRUPT``
+flips a payload byte in one delivered slot before the consumer reads it
+(CRC detect -> quarantine -> reassign, parent-side, one-shot).
 """
 
 from __future__ import annotations
@@ -61,6 +73,13 @@ import time
 from typing import Callable, Iterator, Optional
 
 from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.data.cache import quarantine_append
+from mx_rcnn_tpu.data.shm_ring import (
+    ShmRing,
+    ShmRingWriter,
+    SlotOverflow,
+    shm_eligible,
+)
 
 log = logging.getLogger("mx_rcnn_tpu")
 
@@ -73,11 +92,22 @@ CHAOS_SUICIDE_ENV = "MX_RCNN_CHAOS_DATA_SUICIDE"
 # Chaos: "<global_batch_idx>:<sentinel_path>" — the claiming worker wedges
 # (sleeps without heartbeating) so the watchdog must reap + reassign.
 CHAOS_WEDGE_ENV = "MX_RCNN_CHAOS_DATA_WEDGE"
+# Chaos: "<global_batch_idx>" — the consumer flips one payload byte in
+# that batch's delivered shm slot before decoding it (one-shot): CRC
+# detect -> quarantine -> deterministic reassignment, no worker involved.
+CHAOS_SHM_CORRUPT_ENV = "MX_RCNN_CHAOS_SHM_CORRUPT"
 
 _WORKER_DEPTH = 2      # in-flight tasks per worker (decode pipelining)
 _RESULT_DEPTH = 2      # bounded per-worker result queue (backpressure)
 _POLL_S = 0.02         # consumer poll cadence when nothing is ready
 _BOOT_GRACE_S = 120.0  # heartbeat grace for a worker still importing
+# How long a worker waits on a full shm ring before shipping THAT batch
+# via pickle instead.  Zero-copy slots are pinned until the consumer
+# DROPS the batch, so a consumer that retains every batch (list(...) in
+# tests, an unbounded prefetch buffer) would pin every slot forever —
+# the bounded wait turns that would-be deadlock into a counted, per-batch
+# degrade to the legacy transport.
+_SHM_STALL_BUDGET_S = 0.5
 
 
 class InputServiceDead(RuntimeError):
@@ -119,6 +149,45 @@ def _chaos_claims(spec, idx: int) -> bool:
         return True
 
 
+def _ship_via_ring(writer, idx: int, val, heartbeat, wid: int,
+                   parent_pid: int):
+    """Try the shm path for one assembled value: claim a slot (blocking
+    on backpressure, heartbeating, counting stalls), write, and return
+    the control message — or None to fall back to the pickle path
+    (ineligible value, slot overflow, or a torn-down ring).
+
+    The wait for a free slot is BOUNDED (``_SHM_STALL_BUDGET_S``): slots
+    stay pinned until the consumer drops the delivered batch, so a
+    consumer that retains every batch would otherwise pin every slot and
+    wedge the stream.  When the budget runs out, THIS batch ships as a
+    stall-fallback pickle message (stall count attached) and the ring is
+    retried on the next batch."""
+    if writer is None or not shm_eligible(val):
+        return None
+    stalls = 0
+    slot = writer.acquire(timeout=0.02)
+    while slot is None:
+        # Every slot is in flight: bounded-slot backpressure.  Keep
+        # heartbeating (this is a slow consumer, not a wedge) and count
+        # the wait so the consumer can export it as a ring stall.
+        stalls += 1
+        heartbeat[wid] = time.time()
+        if os.getppid() != parent_pid:
+            os._exit(2)
+        if stalls * 0.2 >= _SHM_STALL_BUDGET_S:
+            return ("shm_stall", idx, (val, stalls))
+        slot = writer.acquire(timeout=0.2)
+    try:
+        nbytes = writer.write(slot, val)
+    except SlotOverflow:
+        writer.unget(slot)
+        return None  # one oversized batch degrades, the stream survives
+    except Exception:  # noqa: BLE001 — ring gone (teardown race)
+        writer.unget(slot)
+        return None
+    return ("shm", idx, (slot, nbytes, stalls))
+
+
 def _service_worker(
     wid: int,
     builder: Callable,
@@ -127,6 +196,7 @@ def _service_worker(
     result_q,
     heartbeat,
     parent_pid: int,
+    ring_handle: Optional[dict] = None,
 ) -> None:
     """Worker main: pull (idx, spec) tasks, assemble, ship (kind, idx, …).
 
@@ -135,11 +205,16 @@ def _service_worker(
     the watchdog's entire signal.  Workers never initialize a jax
     backend; they import the package (threefry flag) and the loader, not
     the model stack.
+
+    With ``ring_handle`` (shm transport) the assembled tensors go into a
+    ring slot and ``result_q`` carries only the slot reference; the
+    pickle message remains the per-batch fallback.
     """
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent owns Ctrl-C
     suicide = _parse_chaos(CHAOS_SUICIDE_ENV, allow_always=True)
     wedge = _parse_chaos(CHAOS_WEDGE_ENV)
     assemble = builder(payload)
+    writer = ShmRingWriter(ring_handle) if ring_handle else None
     while True:
         if os.getppid() != parent_pid:
             os._exit(2)  # orphaned (parent SIGKILLed) — no cleanup to run
@@ -149,6 +224,8 @@ def _service_worker(
         except (queue.Empty, OSError, EOFError):
             continue
         if task is None:
+            if writer is not None:
+                writer.close()
             return
         idx, spec = task
         if _chaos_claims(suicide, idx):
@@ -164,7 +241,10 @@ def _service_worker(
             )
             time.sleep(3600.0)  # no heartbeat: the watchdog reaps us
         try:
-            msg = ("ok", idx, assemble(spec))
+            val = assemble(spec)
+            msg = _ship_via_ring(
+                writer, idx, val, heartbeat, wid, parent_pid
+            ) or ("ok", idx, val)
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
             msg = ("err", idx, f"{type(e).__name__}: {e}")
         while True:
@@ -179,14 +259,17 @@ def _service_worker(
 
 
 class _Slot:
-    """One worker's parent-side state: process, private queues, in-flight
-    indices, and the remaining respawn budget."""
+    """One worker's parent-side state: process, private queues, shm ring
+    (when the transport is on), in-flight indices, and the remaining
+    respawn budget."""
 
-    def __init__(self, proc, task_q, result_q, respawns_left: int) -> None:
+    def __init__(self, proc, task_q, result_q, respawns_left: int,
+                 ring: Optional[ShmRing] = None) -> None:
         self.proc = proc
         self.task_q = task_q
         self.result_q = result_q
         self.respawns_left = respawns_left
+        self.ring = ring
         self.outstanding: set[int] = set()
         self.spawned_at = time.time()
 
@@ -213,6 +296,9 @@ class InputService:
         watchdog_s: Optional[float] = None,
         fallback: bool = True,
         name: str = "input-service",
+        shm_slots: int = 0,
+        shm_slot_bytes: int = 0,
+        quarantine_path: Optional[str] = None,
     ) -> None:
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -226,6 +312,16 @@ class InputService:
             watchdog_s = float(os.environ.get(WATCHDOG_ENV, "30"))
         self._watchdog_s = watchdog_s
         self._boot_grace_s = max(_BOOT_GRACE_S, watchdog_s)
+        # Zero-copy shm transport: one ring per worker when both knobs
+        # are set (data/shm_ring.py); 0 keeps the pickle-through-queue
+        # hand-off.  The quarantine journal is shared with the tensor
+        # cache so corrupt slots and corrupt blobs land in one place.
+        self._shm_slots = max(int(shm_slots), 0)
+        self._shm_slot_bytes = max(int(shm_slot_bytes), 0)
+        self._quarantine_path = quarantine_path
+        self._ring_seq = 0
+        raw = os.environ.get(CHAOS_SHM_CORRUPT_ENV, "").strip()
+        self._chaos_shm_corrupt: Optional[int] = int(raw) if raw else None
         # spawn, not fork: the parent has jax (and often a live backend)
         # loaded — forking a multithreaded jax process deadlocks.
         self._ctx = mp.get_context("spawn")
@@ -250,24 +346,42 @@ class InputService:
         self.reassigned = 0
         log.info(
             "%s: %d decode worker(s) (spawn), respawn budget %d/worker, "
-            "watchdog %.1fs", name, num_workers, respawns, watchdog_s,
+            "watchdog %.1fs, transport %s", name, num_workers, respawns,
+            watchdog_s,
+            f"shm ring ({self._shm_slots} x {self._shm_slot_bytes}B/worker)"
+            if self._shm_on else "pickle queue",
         )
+
+    @property
+    def _shm_on(self) -> bool:
+        return self._shm_slots > 0 and self._shm_slot_bytes > 0
 
     # -- lifecycle ---------------------------------------------------------
 
     def _spawn(self, wid: int, respawns_left: int) -> _Slot:
         task_q = self._ctx.Queue()
         result_q = self._ctx.Queue(maxsize=_RESULT_DEPTH)
+        ring = None
+        if self._shm_on:
+            # A FRESH ring per (worker, respawn): failure isolation
+            # matches the per-worker queues — a crashing writer can tear
+            # only its own segment, and the respawn starts clean.
+            self._ring_seq += 1
+            ring = ShmRing(
+                self._ctx, self._shm_slots, self._shm_slot_bytes,
+                name=f"mxr{os.getpid()}_{self._ring_seq}",
+            )
         self._heartbeat[wid] = 0.0  # 0 = not yet booted (grace applies)
         proc = self._ctx.Process(
             target=_service_worker,
             args=(wid, self._builder, self._payload, task_q, result_q,
-                  self._heartbeat, os.getpid()),
+                  self._heartbeat, os.getpid(),
+                  ring.handle() if ring else None),
             name=f"{self._name}-worker-{wid}",
             daemon=True,
         )
         proc.start()
-        return _Slot(proc, task_q, result_q, respawns_left)
+        return _Slot(proc, task_q, result_q, respawns_left, ring=ring)
 
     def close(self) -> None:
         if self._closed:
@@ -288,6 +402,10 @@ class InputService:
                 slot.proc.kill()
                 slot.proc.join(timeout=2.0)
             self._discard_queues(slot)
+            if slot.ring is not None:
+                # Unlinks now; the segment unmaps once any still-live
+                # zero-copy batch views (already yielded) are collected.
+                slot.ring.close()
         self._slots = [None] * len(self._slots)
 
     @staticmethod
@@ -386,11 +504,14 @@ class InputService:
                 got = True
         return got
 
-    def _accept(self, slot: Optional[_Slot], msg) -> None:
+    def _accept(self, slot: Optional[_Slot], msg,
+                salvage: bool = False) -> None:
         kind, idx, val = msg
         if slot is not None:
             slot.outstanding.discard(idx)
         if idx < self._next_yield or idx in self._done:
+            if kind == "shm" and slot is not None and slot.ring is not None:
+                slot.ring.release(val[0])  # duplicate: recycle the slot
             return  # duplicate after reassignment — content is identical
         if kind == "err":
             # Assembly is deterministic (the loader already absorbs I/O
@@ -401,6 +522,73 @@ class InputService:
                 f"{self._name}: batch {idx} assembly failed in a worker: "
                 f"{val}"
             )
+        if kind == "shm":
+            self._accept_shm(slot, idx, val, salvage)
+            return
+        if kind == "shm_stall":
+            # Worker gave up waiting on a full ring (consumer is holding
+            # yielded batches alive, pinning the slots) and shipped this
+            # batch via pickle.  Count the wait; content is identical.
+            val, stalls = val
+            obs.counter(
+                "data_shm_ring_stalls_total",
+                "worker waits on a full shm ring (backpressure)",
+            ).inc(stalls, service=self._name)
+        self._done[idx] = val
+
+    def _accept_shm(self, slot: _Slot, idx: int, ref, salvage: bool) -> None:
+        """Map one delivered ring slot.  ``salvage=True`` (dead worker)
+        copies out of the segment so the ring can be unlinked; the normal
+        path hands the consumer zero-copy views that release the slot when
+        garbage-collected.  A CRC/torn-write failure is quarantined like a
+        corrupt cache blob and the index reassigned — the yielded stream
+        stays bit-identical."""
+        slot_id, nbytes, stalls = ref
+        if self._chaos_shm_corrupt == idx:
+            self._chaos_shm_corrupt = None  # one-shot
+            log.warning(
+                "%s: chaos: corrupting shm slot %d (batch %d)",
+                self._name, slot_id, idx,
+            )
+            slot.ring.corrupt_slot(slot_id)
+        try:
+            val, _ = slot.ring.read(slot_id, copy=salvage)
+        except ValueError as e:
+            reason = str(e).split(":", 1)[0]
+            if reason not in ("shm_checksum", "shm_truncated"):
+                reason = "shm_decode"
+            obs.emit("data", "shm_quarantine", {
+                "service": self._name, "batch_index": idx,
+                "slot": slot_id, "reason": reason, "error": str(e),
+            }, logger=log)
+            obs.counter(
+                "data_shm_quarantines_total",
+                "corrupt/torn shm ring slots quarantined",
+            ).inc(service=self._name, reason=reason)
+            if self._quarantine_path:
+                quarantine_append(self._quarantine_path, {
+                    "kind": "shm_slot", "service": self._name,
+                    "batch_index": idx, "slot": slot_id,
+                    "reason": reason, "error": str(e),
+                    "time": time.time(),
+                })
+            slot.ring.release(slot_id)
+            heapq.heappush(self._pending, idx)
+            self.reassigned += 1
+            obs.counter(
+                "data_batches_reassigned_total",
+                "in-flight batches returned to the pending heap",
+            ).inc(service=self._name)
+            return
+        obs.counter(
+            "data_shm_bytes_total",
+            "tensor bytes shipped zero-copy through shm rings",
+        ).inc(nbytes, service=self._name)
+        if stalls:
+            obs.counter(
+                "data_shm_ring_stalls_total",
+                "worker waits on a full shm ring (backpressure)",
+            ).inc(stalls, service=self._name)
         self._done[idx] = val
 
     # -- watchdog / failure handling ---------------------------------------
@@ -443,9 +631,12 @@ class InputService:
             slot.proc.join(timeout=5.0)
         # Salvage results the worker delivered before dying — re-assembling
         # them would be wasted work (content is deterministic either way).
+        # salvage=True: shm results are copied out so the dead worker's
+        # ring can be torn down instead of pinning live batch views to an
+        # unlinked segment.
         while True:
             try:
-                self._accept(slot, slot.result_q.get_nowait())
+                self._accept(slot, slot.result_q.get_nowait(), salvage=True)
             except queue.Empty:
                 break
             except Exception:  # noqa: BLE001 — torn pipe dies with worker
@@ -457,6 +648,8 @@ class InputService:
             heapq.heappush(self._pending, idx)
         self.reassigned += len(lost)
         self._discard_queues(slot)
+        if slot.ring is not None:
+            slot.ring.close()  # respawn gets a FRESH ring
         obs.counter(
             "data_worker_deaths_total", "decode worker deaths/wedges"
         ).inc(service=self._name)

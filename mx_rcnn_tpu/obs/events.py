@@ -62,6 +62,20 @@ def _fmt_cache_quarantine(p: dict) -> str:
     ).format(**p)
 
 
+def _fmt_shm_quarantine(p: dict) -> str:
+    return (
+        "shm slot quarantined: batch {batch_index} slot {slot} "
+        "({reason}) — index reassigned"
+    ).format(**p)
+
+
+def _fmt_cache_evict(p: dict) -> str:
+    return (
+        "cache evict: {evicted} blob(s), {freed_bytes}B freed "
+        "({used_bytes}B/{max_bytes}B after)"
+    ).format(**p)
+
+
 def _fmt_guardian_rollback(p: dict) -> str:
     return (
         "guardian: {reason} at step {step} — rolling back to the last "
@@ -206,6 +220,8 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "worker_wedged": (logging.WARNING, _fmt_worker_wedged),
     "service_fallback": (logging.ERROR, _fmt_service_fallback),
     "cache_quarantine": (logging.ERROR, _fmt_cache_quarantine),
+    "shm_quarantine": (logging.ERROR, _fmt_shm_quarantine),
+    "cache_evict": (logging.INFO, _fmt_cache_evict),
     # train loop / guardian
     "guardian_rollback": (logging.ERROR, _fmt_guardian_rollback),
     "rollback_restored": (logging.WARNING, _fmt_rollback_restored),

@@ -9,6 +9,7 @@ independent failure domains: least-loaded routing, hedged retries,
 quarantine/rebuild, zero-downtime weight swap, draining shutdown.
 """
 
+from mx_rcnn_tpu.serve.batcher import PackBuffer
 from mx_rcnn_tpu.serve.degrade import (
     LEVELS,
     CircuitBreaker,
@@ -42,6 +43,7 @@ from mx_rcnn_tpu.serve.router import (
 )
 
 __all__ = [
+    "PackBuffer",
     "LEVELS",
     "CircuitBreaker",
     "HysteresisPlanner",

@@ -1,0 +1,101 @@
+"""Continuous-batching pack policy for the serving engine.
+
+The runner's micro-batch is a STATIC shape: every device call runs
+``batch_size`` slots whether they hold one request or eight (the pad
+rows are zeros the postprocess never reads).  Filling those slots with
+requests from *different* callers is therefore free throughput — the
+device call costs the same, the per-request latency only improves.
+:class:`PackBuffer` is the policy half of that packer, deliberately
+separated from the engine's queue/thread mechanics so it can be tested
+standalone.
+
+Packing rules (docs/serving.md):
+
+* **One program per call.**  A pack shares one compiled program, i.e.
+  one ``(mode, bucket)`` — the ``Plan`` minus its level name.  Mixing
+  degrade levels that map to the same program (``full`` and ``small``
+  never do; ``reduced`` requests always share the smallest bucket) is
+  allowed and exercised by tests.
+* **Deadline-aware ordering.**  The most urgent buffered request —
+  earliest deadline, then earliest arrival; deadline-less requests sort
+  last — picks the program, and its program-mates join it most-urgent
+  first.  With no deadlines anywhere this degenerates to exact FIFO, so
+  the packer composes with hedged retries (a hedge is just a second
+  request, possibly landing in the same pack) and with the
+  ``HysteresisPlanner`` ladder (whose per-request level choice already
+  happened at plan time).
+* **Bitwise identity.**  Rows in a padded micro-batch are independent
+  through letterbox, the jitted graph, and per-row postprocess, so a
+  request's de-interleaved response is bitwise identical whether it
+  shared its device call with seven strangers or rode alone
+  (tests/test_batcher.py proves this against the real runner).
+
+The buffer never blocks and never touches the clock on its own: the
+engine feeds it admitted (planned) requests, expires it with the
+engine's clock, and asks for one pack per device call.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def urgency(req) -> tuple[float, float]:
+    """Sort key: earliest deadline first, arrival order among equals;
+    deadline-less requests pack after every deadlined one."""
+    return (
+        math.inf if req.deadline is None else req.deadline,
+        req.enqueued_at,
+    )
+
+
+class PackBuffer:
+    """Planned requests awaiting a device call, packed by program.
+
+    The engine bounds how many requests it holds out of its admission
+    queue (``2 * batch_size``), so shed semantics stay predictable; the
+    buffer itself is just the ordered pool those requests wait in.
+    """
+
+    def __init__(self) -> None:
+        self._items: list = []
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def add(self, req) -> None:
+        """Admit one planned request (``req.plan`` must be set)."""
+        assert req.plan is not None, "PackBuffer takes PLANNED requests"
+        self._items.append(req)
+
+    def expire(self, now: float) -> list:
+        """Remove and return every request whose deadline has passed —
+        the engine fails them exactly as the unpacked path does."""
+        expired = [
+            r for r in self._items
+            if r.deadline is not None and now > r.deadline
+        ]
+        if expired:
+            dead = set(id(r) for r in expired)
+            self._items = [r for r in self._items if id(r) not in dead]
+        return expired
+
+    def take(self, batch_size: int) -> Optional[list]:
+        """One pack: the most urgent request plus up to ``batch_size - 1``
+        program-mates, most urgent first.  None when empty."""
+        if not self._items:
+            return None
+        lead = min(self._items, key=urgency)
+        key = lead.plan[1:]  # (mode, bucket) — the compiled program
+        group = sorted(
+            (r for r in self._items if r.plan[1:] == key), key=urgency
+        )[:batch_size]
+        picked = set(id(r) for r in group)
+        self._items = [r for r in self._items if id(r) not in picked]
+        return group
+
+    def drain(self) -> list:
+        """Remove and return everything (engine shutdown/failure path)."""
+        items, self._items = self._items, []
+        return items

@@ -23,6 +23,14 @@ production endpoint needs:
 * **Watchdog** — a monitor thread detects a device call that stopped
   returning (hung runtime, wedged tunnel) and fails the engine to DEAD
   so supervisors replace the process instead of black-holing traffic.
+* **Continuous batching** (``batch_size > 1`` + ``pack``) — pending
+  requests from different callers pack into every bucket slot of each
+  device call (serve/batcher.py), deadline-aware, one compiled program
+  per call; each de-interleaved response is bitwise identical to the
+  one-request-per-call path.  The worker holds at most ``2 *
+  batch_size`` requests out of the admission queue, so shed semantics
+  stay bounded; ``pack_window_s`` optionally lingers for stragglers to
+  top off a partial batch.
 
 The engine is generic over a ``runner`` (anything with ``buckets``,
 ``levels()``, ``batch_size``, ``pick_bucket`` and ``run``); the real
@@ -42,6 +50,7 @@ import numpy as np
 
 from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.serve import health as health_mod
+from mx_rcnn_tpu.serve.batcher import PackBuffer
 from mx_rcnn_tpu.serve.degrade import (
     FULL_QUALITY_LEVELS,
     CircuitBreaker,
@@ -530,9 +539,16 @@ class InferenceEngine:
         breaker: Optional[CircuitBreaker] = None,
         replica_id: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
+        pack: bool = True,
+        pack_window_s: float = 0.0,
     ) -> None:
         self.runner = runner
         self._clock = clock
+        # Continuous batching is only meaningful with slots to fill; at
+        # batch_size == 1 the legacy take path is byte-for-byte the same
+        # behavior with less machinery, so keep it.
+        self._pack = bool(pack) and runner.batch_size > 1
+        self.pack_window_s = float(pack_window_s)
         self.default_timeout = default_timeout
         self.hang_timeout = hang_timeout
         self.watchdog_poll = watchdog_poll
@@ -551,6 +567,10 @@ class InferenceEngine:
         )
         self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=max_queue)
         self._carry = None  # InferenceRequest | _STOP carried across takes
+        self._buf = PackBuffer()   # planned requests awaiting a pack
+        self._stop_parked = False  # STOP seen; buffer flushes first
+        self._occ_calls = 0        # device calls (occupancy denominator)
+        self._occ_filled = 0       # request slots filled across them
         self._inflight_since: Optional[float] = None
         self._inflight_plan: Optional[Plan] = None
         self._inflight_reqs: list[InferenceRequest] = []
@@ -718,8 +738,9 @@ class InferenceEngine:
 
     @property
     def queue_depth(self) -> int:
-        """Accepted-but-unserved request count (router load signal)."""
-        return self._queue.qsize()
+        """Accepted-but-unserved request count (router load signal);
+        includes requests pooled in the pack buffer."""
+        return self._queue.qsize() + len(self._buf)
 
     def stats(self) -> dict:
         with self._lock:
@@ -728,14 +749,25 @@ class InferenceEngine:
                 if self._inflight_since is None
                 else round(self._clock() - self._inflight_since, 3)
             )
+        calls, filled = self._occ_calls, self._occ_filled
         return self.health.snapshot(
-            queue_depth=self._queue.qsize(),
+            queue_depth=self.queue_depth,
             inflight_age_s=inflight_age,
             draining=self._draining,
             breaker=self.breaker.state,
             breaker_trips=self.breaker.trips,
             latency_estimates_s=self.estimates.snapshot(),
             buckets=[list(b) for b in self.runner.buckets],
+            occupancy={
+                "pack": self._pack,
+                "batch_size": self.runner.batch_size,
+                "device_calls": calls,
+                "slots_filled": filled,
+                "mean": (
+                    round(filled / (calls * self.runner.batch_size), 4)
+                    if calls else None
+                ),
+            },
         )
 
     # -- planning ----------------------------------------------------------
@@ -831,15 +863,96 @@ class InferenceEngine:
                 batch.append(nxt)
             return batch
 
+    def _expire(self, req: InferenceRequest) -> None:
+        """Fail one request whose deadline passed before its device call
+        — identical outcome to the unpacked path's queue expiry."""
+        self.health.record_deadline_miss()
+        self._note_pressure()
+        req._set_error(DeadlineExceeded("deadline passed while queued"))
+
+    def _admit_buffered(self, item) -> bool:
+        """Plan + buffer one queue item; False when it was the STOP
+        sentinel (which parks: the buffer flushes before the stop)."""
+        if item is self._STOP:
+            self._stop_parked = True
+            return False
+        if item.deadline is not None and self._clock() > item.deadline:
+            self._expire(item)
+            return True
+        item.plan = self._plan(item)
+        if item.queue_span is not None:
+            item.queue_span.end(level=item.plan.level)
+        self._buf.add(item)
+        return True
+
+    def _take_batch_packed(self) -> Optional[list[InferenceRequest]]:
+        """Continuous-batching take: pool up to ``2 * batch_size``
+        planned requests, then pack the most urgent request's program
+        full (serve/batcher.py).  Same contract as :meth:`_take_batch`:
+        None = nothing yet, [] = stop, else a same-program batch."""
+        bs = self.runner.batch_size
+        cap = 2 * bs
+        for r in self._buf.expire(self._clock()):
+            self._expire(r)
+        while not self._stop_parked and len(self._buf) < cap:
+            try:
+                # Block (the worker's idle wait) only when the buffer is
+                # empty; otherwise just sweep what is already queued.
+                if len(self._buf):
+                    item = self._queue.get_nowait()
+                else:
+                    item = self._queue.get(timeout=0.1)
+            except queue_mod.Empty:
+                if not len(self._buf):
+                    return None
+                break
+            if not self._admit_buffered(item):
+                break
+        if not len(self._buf):
+            return [] if self._stop_parked else None
+        if (
+            self.pack_window_s > 0
+            and not self._stop_parked
+            and len(self._buf) < bs
+        ):
+            # Linger for stragglers to top off a partial batch.  Wall
+            # clock, not self._clock: tests drive deadlines with fake
+            # clocks that never advance on their own.
+            t_end = time.monotonic() + self.pack_window_s
+            while len(self._buf) < cap:
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=min(left, 0.01))
+                except queue_mod.Empty:
+                    continue
+                if not self._admit_buffered(item):
+                    break
+        return self._buf.take(bs)
+
     def _worker_loop(self) -> None:
         while not self._stopping:
-            batch = self._take_batch()
+            batch = (
+                self._take_batch_packed() if self._pack
+                else self._take_batch()
+            )
             if batch is None:
                 continue
             if not batch:  # STOP
                 break
             plan = batch[0].plan
             assert plan is not None
+            self._occ_calls += 1
+            self._occ_filled += len(batch)
+            obs.histogram(
+                "serve_batch_occupancy",
+                "request slots filled / slots total per device call",
+                buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            ).observe(
+                len(batch) / self.runner.batch_size,
+                level=plan.level, **self._mlabels,
+            )
             start = self._clock()
             with self._lock:
                 self._inflight_since = start
@@ -898,23 +1011,27 @@ class InferenceEngine:
                 else:
                     self.breaker.record_success()
             for r, res in zip(batch, results):
+                # A pack shares one program but not necessarily one
+                # LEVEL (full + small ride the same compiled full
+                # program): each request reports its own plan's level.
+                level = r.plan.level
                 if r in late:
                     self.health.record_deadline_miss()
                     self._note_pressure()
                     r._set_error(
                         DeadlineExceeded(
-                            f"served at level {plan.level} in "
+                            f"served at level {level} in "
                             f"{latency:.3f}s, past the deadline"
                         )
                     )
                 else:
-                    self.health.record_served(plan.level, latency)
+                    self.health.record_served(level, latency)
                     obs.histogram(
                         "serve_request_latency_seconds",
                         "served request latency (device call to result)",
-                    ).observe(latency, level=plan.level, **self._mlabels)
+                    ).observe(latency, level=level, **self._mlabels)
                     res = dict(res)
-                    res["level"] = plan.level
+                    res["level"] = level
                     res["latency_s"] = latency
                     # Fake runners in tests may not tag provenance.
                     res.setdefault(
@@ -933,6 +1050,8 @@ class InferenceEngine:
     # -- watchdog ----------------------------------------------------------
 
     def _fail_pending(self, error: BaseException) -> None:
+        for r in self._buf.drain():
+            r._set_error(error)
         if self._carry is not None:
             if self._carry is not self._STOP:
                 self._carry._set_error(error)
@@ -984,13 +1103,20 @@ def build_engine(
     cfg,
     variables,
     buckets: Optional[Sequence[tuple[int, int]]] = None,
-    batch_size: int = 1,
+    batch_size: Optional[int] = None,
     int8_head: bool = False,
     device: Optional[object] = None,
     **engine_kwargs,
 ) -> InferenceEngine:
     """Convenience: real runner + engine from a config and variables
-    (checkpoint-restored or freshly initialized)."""
+    (checkpoint-restored or freshly initialized).  ``cfg.serve`` supplies
+    the micro-batch and packing defaults; explicit arguments win."""
+    serve_cfg = getattr(cfg, "serve", None)
+    if batch_size is None:
+        batch_size = serve_cfg.batch_size if serve_cfg is not None else 1
+    if serve_cfg is not None:
+        engine_kwargs.setdefault("pack", serve_cfg.pack)
+        engine_kwargs.setdefault("pack_window_s", serve_cfg.pack_window_s)
     runner = DetectorRunner(
         cfg, variables, buckets=buckets, batch_size=batch_size,
         int8_head=int8_head, device=device,

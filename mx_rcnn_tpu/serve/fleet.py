@@ -936,20 +936,28 @@ def build_fleet(
     variables,
     n_replicas: int,
     buckets: Optional[Sequence[tuple[int, int]]] = None,
-    batch_size: int = 1,
+    batch_size: Optional[int] = None,
     int8_head: bool = False,
     engine_kwargs: Optional[dict] = None,
     **fleet_kwargs,
 ) -> FleetRouter:
     """Real JAX wiring: replica ``rid`` pins to ``jax.devices()[rid]``
     (modulo the device count) through the execution plan, so an
-    N-replica fleet on an N-chip host serves one replica per chip."""
+    N-replica fleet on an N-chip host serves one replica per chip.
+    ``cfg.serve`` supplies micro-batch/packing defaults; explicit
+    arguments and ``engine_kwargs`` win."""
     import jax
 
     from mx_rcnn_tpu.serve.engine import DetectorRunner
 
     devices = jax.devices()
     ekw = dict(engine_kwargs or {})
+    serve_cfg = getattr(cfg, "serve", None)
+    if batch_size is None:
+        batch_size = serve_cfg.batch_size if serve_cfg is not None else 1
+    if serve_cfg is not None:
+        ekw.setdefault("pack", serve_cfg.pack)
+        ekw.setdefault("pack_window_s", serve_cfg.pack_window_s)
 
     def factory(rid: int) -> InferenceEngine:
         runner = DetectorRunner(

@@ -1,0 +1,300 @@
+"""Tests for continuous batching (serve/batcher.py + the engine's packed
+take path).
+
+Covers: the pure pack policy (deadline-first urgency, FIFO degeneration,
+one-program-per-pack grouping, expiry, drain), the engine mechanics
+(strangers share a device call, occupancy accounting, deadline-aware
+program choice, mixed degrade levels riding one program, STOP draining
+the buffer, pack disabled at batch_size 1), and — against the real
+DetectorRunner — the bitwise-identity contract: a request's result is
+identical whether it rode a device call alone or packed with strangers,
+including mixed degrade levels and a hedged duplicate in the same pack.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import get_config
+from mx_rcnn_tpu.serve import InferenceEngine, PackBuffer
+from mx_rcnn_tpu.serve.batcher import urgency
+from test_serve import FakeRunner, _img, _wait  # noqa: F401 — shared fakes
+
+
+class _Req:
+    """Planned-request stub: just the fields the pack policy reads."""
+
+    def __init__(self, plan=("full", "full", (64, 64)), deadline=None,
+                 enqueued_at=0.0):
+        self.plan = plan
+        self.deadline = deadline
+        self.enqueued_at = enqueued_at
+
+
+PROG_A = ("full", "full", (64, 64))
+PROG_B = ("full", "full", (128, 128))
+
+
+class TestPackPolicy:
+    def test_urgency_deadline_first_then_arrival(self):
+        a = _Req(deadline=5.0, enqueued_at=2.0)
+        b = _Req(deadline=None, enqueued_at=0.0)
+        c = _Req(deadline=5.0, enqueued_at=1.0)
+        assert sorted([a, b, c], key=urgency) == [c, a, b]
+
+    def test_fifo_degeneration_without_deadlines(self):
+        buf = PackBuffer()
+        reqs = [_Req(enqueued_at=float(i)) for i in range(5)]
+        for r in reversed(reqs):  # insertion order must not matter
+            buf.add(r)
+        assert buf.take(3) == reqs[:3]
+        assert buf.take(3) == reqs[3:]
+        assert buf.take(3) is None
+
+    def test_most_urgent_picks_the_program(self):
+        buf = PackBuffer()
+        early_a = _Req(plan=PROG_A, enqueued_at=0.0)
+        urgent_b = _Req(plan=PROG_B, deadline=1.0, enqueued_at=9.0)
+        buf.add(early_a)
+        buf.add(urgent_b)
+        # The deadline leads even though it arrived later, and the
+        # other program's request does NOT join its pack.
+        assert buf.take(4) == [urgent_b]
+        assert buf.take(4) == [early_a]
+
+    def test_program_mates_join_most_urgent_first(self):
+        buf = PackBuffer()
+        lead = _Req(plan=PROG_A, deadline=1.0, enqueued_at=5.0)
+        mate1 = _Req(plan=PROG_A, deadline=2.0, enqueued_at=6.0)
+        mate2 = _Req(plan=PROG_A, enqueued_at=0.0)
+        stranger = _Req(plan=PROG_B, enqueued_at=0.0)
+        for r in (mate2, stranger, mate1, lead):
+            buf.add(r)
+        assert buf.take(2) == [lead, mate1]  # capped at batch_size
+        assert len(buf) == 2
+
+    def test_expire_removes_only_past_deadlines(self):
+        buf = PackBuffer()
+        live = _Req(deadline=10.0)
+        dead = _Req(deadline=1.0)
+        undying = _Req()
+        for r in (live, dead, undying):
+            buf.add(r)
+        assert buf.expire(5.0) == [dead]
+        assert len(buf) == 2
+        assert buf.expire(5.0) == []
+
+    def test_drain_returns_everything(self):
+        buf = PackBuffer()
+        reqs = [_Req() for _ in range(3)]
+        for r in reqs:
+            buf.add(r)
+        assert buf.drain() == reqs
+        assert len(buf) == 0 and buf.take(4) is None
+
+
+class TestEnginePacking:
+    def test_pack_disabled_at_batch_size_one(self):
+        e = InferenceEngine(FakeRunner(batch_size=1), pack=True)
+        assert not e._pack
+        e2 = InferenceEngine(FakeRunner(batch_size=4), pack=True)
+        assert e2._pack
+
+    def test_strangers_share_one_device_call(self):
+        gate = threading.Event()
+        runner = FakeRunner(batch_size=4, block=gate)
+        e = InferenceEngine(runner).start()
+        try:
+            first = e.submit(_img(8, 8))
+            _wait(lambda: runner.run_calls)  # worker blocked in call 1
+            others = [e.submit(_img(8, 8)) for _ in range(4)]
+            gate.set()
+            results = [r.result(timeout=5) for r in [first, *others]]
+            assert all(res["level"] == "full" for res in results)
+            # Call 1 ran solo (it was taken before the strangers
+            # arrived); the strangers all packed into call 2.
+            assert [n for _, _, n in runner.run_calls] == [1, 4]
+            occ = e.stats()["occupancy"]
+            assert occ["pack"] and occ["device_calls"] == 2
+            assert occ["slots_filled"] == 5
+            assert occ["mean"] == pytest.approx(5 / 8)
+        finally:
+            gate.set()
+            e.stop()
+
+    def test_deadline_picks_the_next_program(self):
+        """With two programs buffered, the deadlined request's program
+        runs first even though the deadline-less one arrived earlier."""
+        gate = threading.Event()
+        runner = FakeRunner(batch_size=2, block=gate)
+        e = InferenceEngine(runner).start()
+        try:
+            first = e.submit(_img(8, 8))
+            _wait(lambda: runner.run_calls)
+            casual = e.submit(_img(100, 100))        # big bucket, no deadline
+            urgent = e.submit(_img(8, 8), timeout=30)  # small bucket, deadline
+            _wait(lambda: e.queue_depth == 2)
+            gate.set()
+            for r in (first, casual, urgent):
+                r.result(timeout=5)
+            assert [b for _, b, _ in runner.run_calls] == [
+                (64, 64), (64, 64), (128, 128)
+            ]
+        finally:
+            gate.set()
+            e.stop()
+
+    def test_mixed_levels_share_a_pack(self):
+        """'small' of a big image and 'full' of a small image compile to
+        the SAME program — they must ride one device call together."""
+        gate = threading.Event()
+        runner = FakeRunner(batch_size=2, block=gate)
+        e = InferenceEngine(runner).start()
+        try:
+            e.estimates.observe("full", 10.0)
+            e.estimates.observe("small", 1e-4)
+            first = e.submit(_img(8, 8))
+            _wait(lambda: runner.run_calls)
+            degraded = e.submit(_img(100, 100), timeout=1.0)  # plans "small"
+            full = e.submit(_img(8, 8))                       # plans "full"
+            _wait(lambda: e.queue_depth == 2)
+            gate.set()
+            first.result(timeout=5)
+            assert degraded.result(timeout=5)["level"] == "small"
+            assert full.result(timeout=5)["level"] == "full"
+            assert [n for _, _, n in runner.run_calls] == [1, 2]
+            assert runner.run_calls[1][1] == (64, 64)
+        finally:
+            gate.set()
+            e.stop()
+
+    def test_stop_drains_buffered_requests(self):
+        gate = threading.Event()
+        runner = FakeRunner(batch_size=2, block=gate)
+        e = InferenceEngine(runner).start()
+        try:
+            first = e.submit(_img(8, 8))
+            _wait(lambda: runner.run_calls)
+            queued = [e.submit(_img(8, 8)) for _ in range(3)]
+            gate.set()
+            stopper = threading.Thread(target=lambda: e.stop(timeout=10))
+            stopper.start()
+            for r in [first, *queued]:
+                assert r.result(timeout=5)["level"] == "full"
+            stopper.join(timeout=10)
+            assert not stopper.is_alive()
+        finally:
+            gate.set()
+            e.stop(timeout=2)
+
+    def test_buffered_deadline_expires_before_device_call(self):
+        gate = threading.Event()
+        runner = FakeRunner(batch_size=2, block=gate)
+        e = InferenceEngine(runner).start()
+        try:
+            first = e.submit(_img(8, 8))
+            _wait(lambda: runner.run_calls)
+            doomed = e.submit(_img(8, 8), timeout=0.05)
+            _wait(lambda: e.queue_depth == 1)
+            time.sleep(0.2)  # deadline passes while buffered
+            gate.set()
+            first.result(timeout=5)
+            from mx_rcnn_tpu.serve import DeadlineExceeded
+
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=5)
+            assert e.stats()["deadline_missed"] == 1
+        finally:
+            gate.set()
+            e.stop()
+
+
+def _bitwise(res, ref):
+    for key in ("boxes", "scores", "classes"):
+        np.testing.assert_array_equal(res[key], ref[key])
+
+
+class TestPackedBitwiseIdentity:
+    """Packing must change throughput, never bytes: each request's
+    de-interleaved result is identical to running it one-per-call on the
+    same runner."""
+
+    @pytest.fixture(scope="class")
+    def runner(self):
+        import jax
+
+        from mx_rcnn_tpu.detection import TwoStageDetector
+        from mx_rcnn_tpu.detection.graph import init_detector
+        from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+        cfg = get_config("tiny_synthetic")
+        model = TwoStageDetector(cfg=cfg.model)
+        h, w = cfg.data.image_size
+        variables = init_detector(model, jax.random.PRNGKey(0), (h, w))
+        runner = DetectorRunner(
+            cfg, variables, buckets=((64, 64), (h, w)), batch_size=4,
+            with_proposals=False,
+        )
+        runner.warmup()
+        return runner
+
+    def _imgs(self, sizes, seed=7):
+        r = np.random.RandomState(seed)
+        return [
+            r.randint(0, 255, (h, w, 3), np.uint8).astype(np.float32)
+            for h, w in sizes
+        ]
+
+    def test_packed_matches_solo_bitwise(self, runner):
+        big = runner.buckets[-1]
+        imgs = self._imgs([(80, 100), big, (70, 90), (90, 110)])
+        refs = [runner.run("full", big, [im])[0] for im in imgs]
+        with InferenceEngine(runner, pack_window_s=0.5) as e:
+            reqs = [e.submit(im) for im in imgs]
+            results = [r.result(timeout=30) for r in reqs]
+            occ = e.stats()["occupancy"]
+        for res, ref in zip(results, refs):
+            assert res["level"] == "full"
+            _bitwise(res, ref)
+        # The identity only means something if packing actually happened.
+        assert occ["device_calls"] < len(imgs)
+
+    def test_mixed_degrade_levels_pack_bitwise(self, runner):
+        small_bucket = runner.buckets[0]
+        big_img, small_img = self._imgs([runner.buckets[-1], (48, 56)])
+        ref_big = runner.run("full", small_bucket, [big_img])[0]
+        ref_small = runner.run("full", small_bucket, [small_img])[0]
+        with InferenceEngine(runner, pack_window_s=1.0) as e:
+            # Estimates that force the deadlined request down to "small"
+            # — which shares the full program at the smallest bucket
+            # with the deadline-less request's "full" plan.
+            e.estimates.observe("full", 10.0)
+            e.estimates.observe("small", 1e-4)
+            degraded = e.submit(big_img, timeout=8.0)
+            full = e.submit(small_img)
+            res_big = degraded.result(timeout=30)
+            res_small = full.result(timeout=30)
+            occ = e.stats()["occupancy"]
+        assert res_big["level"] == "small"
+        assert res_small["level"] == "full"
+        _bitwise(res_big, ref_big)
+        _bitwise(res_small, ref_small)
+        assert occ["device_calls"] == 1  # one pack served both levels
+
+    def test_hedged_duplicate_in_same_pack_bitwise(self, runner):
+        """A hedge is just a second copy of the request — landing in the
+        same pack it must produce the identical bytes."""
+        big = runner.buckets[-1]
+        (img,) = self._imgs([(84, 104)])
+        ref = runner.run("full", big, [img])[0]
+        with InferenceEngine(runner, pack_window_s=0.5) as e:
+            r1 = e.submit(img)
+            r2 = e.submit(img)
+            res1 = r1.result(timeout=30)
+            res2 = r2.result(timeout=30)
+            occ = e.stats()["occupancy"]
+        _bitwise(res1, ref)
+        _bitwise(res2, ref)
+        assert occ["device_calls"] == 1

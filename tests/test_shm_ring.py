@@ -1,0 +1,350 @@
+"""Tests for the zero-copy shm ring transport (data/shm_ring.py) and its
+wiring into the input service (data/service.py).
+
+Covers: the slot codec (roundtrip with None fields, read-only zero-copy
+views, wraparound reuse, SlotOverflow, torn-writer detection, CRC
+corruption), slot lease accounting (views pin the slot; GC releases it),
+the service-level guarantees (shm stream bitwise-identical to sync with
+the ring demonstrably engaged, SIGKILL salvage copies out of a doomed
+ring, chaos-corrupted slots quarantine + reassign without changing the
+yielded stream), and the bounded-stall degrade (a consumer that retains
+every batch pins every slot — the stream must fall back per-batch, never
+wedge).
+"""
+
+import gc
+import multiprocessing as mp
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.data.batch import Batch
+from mx_rcnn_tpu.data.cache import quarantine_read
+from mx_rcnn_tpu.data.loader import DetectionLoader, _service_assembler
+from mx_rcnn_tpu.data.service import CHAOS_SHM_CORRUPT_ENV, InputService
+from mx_rcnn_tpu.data.shm_ring import (
+    HEADER_RESERVE,
+    MAGIC,
+    ShmRing,
+    ShmRingWriter,
+    SlotOverflow,
+    shm_eligible,
+)
+from test_data_service import (  # noqa: F401 — shared fixtures/helpers
+    assert_batches_equal,
+    make_cfg,
+    make_roidb,
+    sync_batches,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def make_batch(rng, b=2, h=16, w=24, g=4, masks=False):
+    return Batch(
+        images=(rng.rand(b, h, w, 3) * 255).astype(np.uint8),
+        image_hw=np.array([[h, w]] * b, np.float32),
+        gt_boxes=rng.rand(b, g, 4).astype(np.float32),
+        gt_classes=rng.randint(0, 5, (b, g)).astype(np.int32),
+        gt_valid=rng.rand(b, g) > 0.5,
+        gt_masks=rng.rand(b, g, 8, 8).astype(np.float32) if masks else None,
+    )
+
+
+def ring_pair(slots=2, slot_bytes=1 << 16):
+    """(ring, writer) sharing one segment — same-process, same API the
+    worker uses across the spawn boundary."""
+    ring = ShmRing(mp.get_context("spawn"), slots, slot_bytes)
+    return ring, ShmRingWriter(ring.handle())
+
+
+class TestCodec:
+    def test_eligibility(self, rng):
+        assert shm_eligible(make_batch(rng))
+        assert shm_eligible(make_batch(rng, masks=True))
+        assert not shm_eligible((1, 2))           # not a NamedTuple
+        assert not shm_eligible("nope")
+        bad = make_batch(rng)._replace(
+            images=np.array([object()], dtype=object)
+        )
+        assert not shm_eligible(bad)              # object dtype
+
+    def test_roundtrip_bitwise_with_none_fields(self, rng):
+        ring, writer = ring_pair()
+        try:
+            for masks in (False, True):
+                val = make_batch(rng, masks=masks)
+                slot = writer.acquire(timeout=1.0)
+                nbytes = writer.write(slot, val)
+                got, total = ring.read(slot, copy=True)
+                assert total == nbytes
+                assert type(got) is Batch
+                assert_batches_equal(val, got)
+                ring.release(slot)
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_zero_copy_views_are_readonly(self, rng):
+        ring, writer = ring_pair()
+        try:
+            val = make_batch(rng)
+            slot = writer.acquire(timeout=1.0)
+            writer.write(slot, val)
+            got, _ = ring.read(slot, copy=False)
+            assert not got.images.flags.writeable
+            with pytest.raises((ValueError, RuntimeError)):
+                got.images[0, 0, 0, 0] = 1
+            assert_batches_equal(val, got)
+            del got
+            gc.collect()
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_wraparound_reuses_slots_bitwise(self, rng):
+        """10 values through a 2-slot ring: every delivery bitwise, every
+        slot reused without residue from the previous occupant."""
+        ring, writer = ring_pair(slots=2)
+        try:
+            vals = [make_batch(rng, b=1 + (i % 2)) for i in range(10)]
+            for val in vals:
+                slot = writer.acquire(timeout=1.0)
+                assert slot is not None
+                writer.write(slot, val)
+                got, _ = ring.read(slot, copy=True)
+                assert_batches_equal(val, got)
+                ring.release(slot)
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_overflow_leaves_slot_reusable(self, rng):
+        ring, writer = ring_pair(slot_bytes=HEADER_RESERVE + 1024)
+        try:
+            slot = writer.acquire(timeout=1.0)
+            with pytest.raises(SlotOverflow):
+                writer.write(slot, make_batch(rng, b=4, h=64, w=64))
+            # The failed write invalidated the slot; a small value fits.
+            small = Batch(
+                images=np.zeros((1, 4, 4, 3), np.uint8),
+                image_hw=np.zeros((1, 2), np.float32),
+                gt_boxes=np.zeros((1, 1, 4), np.float32),
+                gt_classes=np.zeros((1, 1), np.int32),
+                gt_valid=np.zeros((1, 1), bool),
+            )
+            writer.write(slot, small)
+            got, _ = ring.read(slot, copy=True)
+            assert_batches_equal(small, got)
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_torn_writer_detected(self, rng):
+        """A slot whose final magic write never landed (writer died
+        mid-write) must read as shm_truncated, not as stale data."""
+        ring, writer = ring_pair()
+        try:
+            slot = writer.acquire(timeout=1.0)
+            writer.write(slot, make_batch(rng))
+            base = slot * ring.slot_bytes
+            ring._shm.buf[base:base + len(MAGIC)] = b"\x00" * len(MAGIC)
+            with pytest.raises(ValueError, match="^shm_truncated"):
+                ring.read(slot, copy=True)
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_crc_corruption_detected(self, rng):
+        ring, writer = ring_pair()
+        try:
+            slot = writer.acquire(timeout=1.0)
+            writer.write(slot, make_batch(rng))
+            ring.corrupt_slot(slot)
+            with pytest.raises(ValueError, match="^shm_checksum"):
+                ring.read(slot, copy=True)
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_views_never_xla_alignable(self, rng):
+        """The lease protocol is sound only if the device feed COPIES:
+        jax's CPU backend zero-copy-aliases 64-byte-aligned numpy arrays
+        into device buffers the view finalizers can't see, so every
+        exported view must land at 8 (mod 64) — 8-byte aligned for
+        numpy, never the >=16 XLA needs — and device_put must return a
+        buffer at a different address."""
+        import jax
+
+        ring, writer = ring_pair()
+        try:
+            val = make_batch(rng, masks=True)
+            slot = writer.acquire(timeout=1.0)
+            writer.write(slot, val)
+            got, _ = ring.read(slot, copy=False)
+            for field in got:
+                if field is None:
+                    continue
+                ptr = field.__array_interface__["data"][0]
+                assert ptr % 64 == 8
+                arr = jax.device_put(field)
+                arr.block_until_ready()
+                dst = np.asarray(arr).__array_interface__["data"][0]
+                assert dst != ptr, "device_put aliased a ring slot"
+            del got, field, arr
+            gc.collect()
+        finally:
+            writer.close()
+            ring.close()
+
+    def test_views_pin_slot_until_gc(self, rng):
+        """copy=False leases the slot: it must NOT return to the free
+        queue while any field view is alive, and MUST once they die."""
+        ring, writer = ring_pair(slots=1)
+        try:
+            slot = writer.acquire(timeout=1.0)
+            writer.write(slot, make_batch(rng))
+            got, _ = ring.read(slot, copy=False)  # pins until views die
+            assert ring.leases == 1
+            assert writer.acquire(timeout=0.1) is None
+            del got
+            gc.collect()
+            assert writer.acquire(timeout=1.0) == slot
+        finally:
+            writer.close()
+            ring.close()
+
+
+class TestServiceShm:
+    def _loader(self, roidb, cfg, **kw):
+        kw.setdefault("service_workers", 2)
+        return DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False,
+            num_workers=0, **kw,
+        )
+
+    def test_shm_stream_bitwise_and_engaged(self, rng):
+        """The ring path must change the bytes on the wire, never the
+        bytes in the batch: identical stream, nonzero shm byte counter."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg(shm_slots=4)
+        ref = sync_batches(roidb, cfg)
+        loader = self._loader(roidb, cfg)
+        got = []
+        # Copy-and-drop each batch as a well-behaved consumer would:
+        # retaining the zero-copy views themselves would pin the slots.
+        for batch in loader._raw_train_batches(0, epochs=2):
+            got.append(Batch(*[None if f is None else np.asarray(f).copy()
+                               for f in batch]))
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+        assert obs.counter("data_shm_bytes_total").value(
+            service="input-service"
+        ) > 0
+
+    def test_shm_off_knob_respected(self, rng):
+        roidb = make_roidb(rng, n=4)
+        cfg = make_cfg(shm_transport=False)
+        ref = sync_batches(roidb, cfg, epochs=1)
+        got = list(self._loader(roidb, cfg)._raw_train_batches(0, epochs=1))
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+        assert obs.counter("data_shm_bytes_total").value(
+            service="input-service"
+        ) == 0
+
+    def test_worker_sigkill_salvage_bitwise(self, rng):
+        """SIGKILL a worker mid-stream with the ring on: in-flight slots
+        are salvaged by copy, the doomed ring unlinked, and the stream
+        stays bit-identical."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg(shm_slots=4)
+        ref = sync_batches(roidb, cfg)
+        loader = self._loader(roidb, cfg, worker_respawns=2)
+        before = set(p.pid for p in mp.active_children())
+        got = []
+        killed = False
+        for batch in loader._raw_train_batches(0, epochs=2):
+            got.append(Batch(*[None if f is None else np.asarray(f).copy()
+                               for f in batch]))
+            if not killed and len(got) == 2:
+                workers = [
+                    p for p in mp.active_children() if p.pid not in before
+                ]
+                assert workers, "service spawned no visible workers"
+                os.kill(workers[0].pid, signal.SIGKILL)
+                killed = True
+        assert killed
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+
+    def test_chaos_corrupt_quarantines_and_reassigns(
+        self, rng, tmp_path, monkeypatch
+    ):
+        """MX_RCNN_CHAOS_SHM_CORRUPT flips a byte in one delivered slot:
+        the CRC catches it, the slot is quarantined (journal line +
+        counter), the index reassigned — and the yielded stream is still
+        bitwise identical."""
+        monkeypatch.setenv(CHAOS_SHM_CORRUPT_ENV, "3")
+        qpath = str(tmp_path / "quarantine.jsonl")
+        roidb = make_roidb(rng)
+        cfg = make_cfg(shm_slots=4)
+        ref = sync_batches(roidb, cfg)
+        loader = self._loader(roidb, cfg, quarantine_path=qpath)
+        got = []
+        for batch in loader._raw_train_batches(0, epochs=2):
+            got.append(Batch(*[None if f is None else np.asarray(f).copy()
+                               for f in batch]))
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+        assert obs.counter("data_shm_quarantines_total").value(
+            service="input-service", reason="shm_checksum"
+        ) == 1
+        records = [
+            r for r in quarantine_read(qpath) if r.get("kind") == "shm_slot"
+        ]
+        assert len(records) == 1
+        assert records[0]["batch_index"] == 3
+        assert records[0]["reason"] == "shm_checksum"
+
+    def test_retaining_consumer_degrades_instead_of_wedging(self, rng):
+        """Zero-copy slots stay pinned while the consumer holds the
+        batch.  A consumer that retains EVERYTHING (list(...)) would pin
+        every slot forever — the bounded stall budget must turn that into
+        per-batch pickle fallback, with the stalls counted, never a hang."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        ref = sync_batches(roidb, cfg)
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False, num_workers=0,
+        )
+        svc = InputService(
+            specs=loader._local_spec_stream(0, epochs=2),
+            assemble=loader._assemble_rows,
+            builder=_service_assembler,
+            payload=loader._worker_payload(),
+            num_workers=2,
+            shm_slots=1,                       # pathologically tight ring
+            shm_slot_bytes=loader._shm_slot_bytes(),
+        )
+        try:
+            got = list(svc)                    # retains every batch
+        finally:
+            svc.close()
+        assert len(got) == len(ref)
+        for a, b in zip(ref, got):
+            assert_batches_equal(a, b)
+        assert obs.counter("data_shm_ring_stalls_total").value(
+            service="input-service"
+        ) > 0

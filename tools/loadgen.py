@@ -24,6 +24,14 @@ exercises quarantine -> rebuild -> reinstatement *under load*: the bench
 passes only if accepted requests keep completing and p99 stays under the
 ``--assert-p99`` bound while a replica is out.
 
+``--clients N`` switches to a CLOSED-loop shape instead: N concurrent
+small clients each submit one request, wait for its response, and
+immediately submit the next — the many-small-callers traffic that
+cross-request packing (``--batch-size > 1``) exists for.  The
+BENCH_serving line always reports batch occupancy (mean + p50 over
+device calls) and ``sustained_qps_per_replica``; ``--assert-occupancy``
+gates on the mean.
+
 Prints diagnostics to stderr and exactly one ``BENCH_serving`` JSON line
 as the LAST line on stdout:
 
@@ -134,7 +142,11 @@ def run_bench(args: argparse.Namespace) -> dict:
     )
     fleet = build_fleet(
         cfg, variables, args.replicas,
-        engine_kwargs={"hang_timeout": 300.0, "max_queue": args.max_queue},
+        batch_size=args.batch_size,
+        engine_kwargs={
+            "hang_timeout": 300.0, "max_queue": args.max_queue,
+            "pack": not args.no_pack, "pack_window_s": args.pack_window,
+        },
         supervisor_poll=0.1,
         hedge_after="auto",
     )
@@ -167,6 +179,67 @@ def run_bench(args: argparse.Namespace) -> dict:
             latencies.append(time.monotonic() - t_submit)
 
     killed_rid = None
+    if args.clients > 0:
+        # Closed loop: N concurrent small clients, each waiting for its
+        # response before submitting again — per-caller concurrency is 1,
+        # so only CROSS-request packing can fill a micro-batch.
+        t0 = time.monotonic()
+        deadline_wall = t0 + args.duration
+        kill_lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            nonlocal submitted, shed, failed, killed_rid
+            while True:
+                now = time.monotonic()
+                if now >= deadline_wall:
+                    return
+                if args.kill_one and now - t0 >= args.duration / 2.0:
+                    with kill_lock:
+                        if killed_rid is None:
+                            killed_rid = 0
+                            fleet.kill_replica(0, "loadgen --kill-one")
+                            print(f"[loadgen] killed replica 0 at "
+                                  f"t={now - t0:.1f}s", file=sys.stderr)
+                trace_id = obs.new_trace_id() if obs_on else None
+                try:
+                    freq = fleet.submit(
+                        images[ci % len(images)],
+                        timeout=args.deadline, trace_id=trace_id,
+                    )
+                except Overloaded:
+                    with lock:
+                        submitted += 1
+                        shed += 1
+                    time.sleep(0.01)
+                    continue
+                except ServeError as e:
+                    with lock:
+                        submitted += 1
+                        failed += 1
+                    print(f"[loadgen] submit failed: {e}", file=sys.stderr)
+                    time.sleep(0.05)
+                    continue
+                with lock:
+                    submitted += 1
+                try:
+                    freq.result(timeout=args.deadline + 60.0)
+                except ServeError:
+                    with lock:
+                        failed += 1
+                    continue
+                with lock:
+                    latencies.append(time.monotonic() - now)
+
+        clients = [
+            threading.Thread(target=client, args=(ci,), daemon=True)
+            for ci in range(args.clients)
+        ]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join(timeout=args.duration + args.deadline + 120.0)
+        return _finish(args, fleet, latencies, submitted, shed, failed,
+                       killed_rid, obs_on)
     rate = make_profile(
         args.profile, args.qps,
         amplitude=args.amplitude, period_s=args.period,
@@ -220,6 +293,42 @@ def run_bench(args: argparse.Namespace) -> dict:
 
     for t in pending:
         t.join(timeout=args.deadline + 120.0)
+    return _finish(args, fleet, latencies, submitted, shed, failed,
+                   killed_rid, obs_on)
+
+
+def _occupancy_summary() -> dict:
+    """Aggregate the ``serve_batch_occupancy`` histogram across every
+    replica/level series: device-call count, mean fill, p50 fill."""
+    from mx_rcnn_tpu import obs
+    from mx_rcnn_tpu.obs import metrics as metrics_mod
+
+    snap = obs.histogram(
+        "serve_batch_occupancy",
+        "request slots filled / slots total per device call",
+    ).snapshot()
+    series = [s for s in snap.values() if s.get("count")]
+    calls = sum(s["count"] for s in series)
+    if not calls:
+        return {"device_calls": 0, "mean": None, "p50": None}
+    le = series[0]["le"]
+    merged = [0] * len(le)
+    for s in series:
+        for i, c in enumerate(s["buckets"]):
+            merged[i] += c
+    return {
+        "device_calls": calls,
+        "mean": round(sum(s["sum"] for s in series) / calls, 4),
+        "p50": round(
+            metrics_mod.percentile_from_counts(le, merged, 0.50), 4
+        ),
+    }
+
+
+def _finish(args, fleet, latencies, submitted, shed, failed, killed_rid,
+            obs_on) -> dict:
+    from mx_rcnn_tpu import obs
+
     stats = fleet.stats()
     # Generous stop budget: --kill-one leaves a background rebuild whose
     # warmup compile cannot be interrupted; stop() waits it out.
@@ -231,14 +340,21 @@ def run_bench(args: argparse.Namespace) -> dict:
         "replicas": args.replicas,
         "qps": args.qps,
         "profile": args.profile,
+        "clients": args.clients,
+        "batch_size": args.batch_size,
+        "pack": not args.no_pack,
         "duration_s": args.duration,
         "submitted": submitted,
         "completed": len(latencies),
         "shed": shed,
         "failed": failed,
+        "sustained_qps_per_replica": round(
+            len(latencies) / args.duration / max(args.replicas, 1), 3
+        ),
         "p50_s": round(_percentile(latencies, 0.50), 4),
         "p99_s": round(_percentile(latencies, 0.99), 4),
         "max_s": round(max(latencies), 4) if latencies else float("nan"),
+        "occupancy": _occupancy_summary(),
         "killed_rid": killed_rid,
         "quarantines": stats["quarantines"],
         "reinstatements": stats["reinstatements"],
@@ -296,12 +412,29 @@ def main(argv=None) -> int:
                    help="per-request deadline in seconds")
     p.add_argument("--max-queue", type=int, default=64,
                    help="per-replica admission queue bound")
+    p.add_argument("--clients", type=int, default=0,
+                   help="closed-loop mode: this many concurrent "
+                        "one-request-at-a-time clients instead of the "
+                        "open-loop --qps schedule (0 = open loop)")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-replica micro-batch slots (device call "
+                        "width); default follows cfg.serve.batch_size")
+    p.add_argument("--no-pack", action="store_true",
+                   help="disable continuous batching (one caller's "
+                        "same-plan run per device call, as before)")
+    p.add_argument("--pack-window", type=float, default=0.0,
+                   help="seconds the worker lingers for stragglers to "
+                        "top off a partial batch")
     p.add_argument("--config", default="tiny_synthetic")
     p.add_argument("--kill-one", action="store_true",
                    help="kill replica 0 at the midpoint of the window")
     p.add_argument("--assert-p99", type=float, default=None,
                    help="exit nonzero unless p99 latency (s) is under "
                         "this bound and no accepted request failed")
+    p.add_argument("--assert-occupancy", type=float, default=None,
+                   help="exit nonzero unless mean batch occupancy "
+                        "(slots filled / slots total per device call) "
+                        "is at least this bound")
     p.add_argument("--obs-dir", default=None,
                    help="write the obs journal, per-request span files "
                         "and flight dumps under this directory")
@@ -330,6 +463,12 @@ def main(argv=None) -> int:
         print(f"[loadgen] FAIL: p99 {rec['p99_s']}s >= bound "
               f"{args.assert_p99}s", file=sys.stderr)
         ok = False
+    if args.assert_occupancy is not None:
+        mean_occ = rec["occupancy"]["mean"]
+        if mean_occ is None or mean_occ < args.assert_occupancy:
+            print(f"[loadgen] FAIL: mean batch occupancy {mean_occ} < "
+                  f"bound {args.assert_occupancy}", file=sys.stderr)
+            ok = False
     return 0 if ok else 1
 
 

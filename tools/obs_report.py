@@ -43,7 +43,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # routine chatter (metrics_flush, shed) out of it.
 INCIDENT_KINDS = frozenset({
     "worker_death", "worker_retired", "worker_wedged", "service_fallback",
-    "cache_quarantine",
+    "cache_quarantine", "shm_quarantine", "cache_evict",
     "guardian_rollback", "rollback_restored", "guardian_loss_spike",
     "training_diverged", "preempt_drain",
     "checkpoint_saved", "checkpoint_restored",
@@ -96,6 +96,41 @@ def _slo_section(journal: list[dict], t0: float) -> dict:
         "burn_alerts": burn_alerts,
         "resize_decisions": resize_decisions,
     }
+
+
+def _pack_section(journal: list[dict]) -> dict:
+    """Packing / zero-copy efficiency from the last ``metrics_flush``
+    snapshot: the ``serve_batch_occupancy`` histogram collapsed to a
+    device-call count + mean fill, and the shm ring counters — so a
+    report always answers "were the device calls full and did the data
+    plane copy" without re-scraping /metrics."""
+    snap: dict = {}
+    for rec in journal:
+        if rec.get("kind") == "metrics_flush":
+            s = (rec.get("payload") or {}).get("snapshot") or {}
+            if s:
+                snap = s  # keep the LAST flush (cumulative series)
+    out: dict = {}
+    occ = snap.get("serve_batch_occupancy")
+    if isinstance(occ, dict) and occ:
+        calls = sum(
+            v.get("count", 0) for v in occ.values() if isinstance(v, dict)
+        )
+        filled = sum(
+            v.get("sum", 0.0) for v in occ.values() if isinstance(v, dict)
+        )
+        out["batch_occupancy"] = {
+            "device_calls": calls,
+            "mean": round(filled / calls, 4) if calls else None,
+        }
+    for name in ("data_shm_bytes_total", "data_shm_ring_stalls_total",
+                 "data_shm_quarantines_total"):
+        series = snap.get(name)
+        if isinstance(series, dict) and series:
+            out[name] = round(sum(
+                v for v in series.values() if isinstance(v, (int, float))
+            ), 2)
+    return out
 
 
 def _read_jsonl(path: str) -> list[dict]:
@@ -186,6 +221,7 @@ def build_report(
         "events_by_kind": dict(sorted(events_by_kind.items())),
         "incident_timeline": timeline,
         "slo": _slo_section(journal, t0),
+        "data_plane": _pack_section(journal),
         "spans": {
             "count": len(spans),
             "traces": len(traces),

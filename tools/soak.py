@@ -53,7 +53,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tools.loadgen import _hermetic_cpu, _percentile, make_profile
+from tools.loadgen import (
+    _hermetic_cpu,
+    _occupancy_summary,
+    _percentile,
+    make_profile,
+)
 
 
 class _SoakRunner:
@@ -353,6 +358,16 @@ def run_soak(args: argparse.Namespace) -> dict:
         "quarantines": stats["quarantines"],
         "reinstatements": stats["reinstatements"],
         "latency_by_level": latency_by_level,
+        # Packing/zero-copy efficiency: batch occupancy across every
+        # replica's device calls, plus the shm ring counters when the
+        # data plane ran in-process (subprocess chaos counters live in
+        # the children's own BENCH lines).
+        "occupancy": _occupancy_summary(),
+        "shm": {
+            name: round(sum(series.values()), 2)
+            for name, series in obs.registry().snapshot().items()
+            if name.startswith("data_shm_") and series
+        },
         "slo": {
             "fast_s": round(fast_s, 2),
             "slow_s": round(slow_s, 2),
