@@ -79,8 +79,12 @@ def resolved_knobs(cfg) -> dict:
         "packed_head": m.rpn.packed_head,
         "roi_align_impl": m.rcnn.roi_align_impl,
         "roi_align_bwd_impl": m.rcnn.roi_align_bwd_impl,
+        "nms_impl": m.rpn.nms_impl,
+        "fused_middle": m.rpn.fused_middle,
+        "roi_block": m.rcnn.roi_block,
         "steps_per_call": cfg.train.steps_per_call,
         "accum_steps": cfg.train.accum_steps,
+        "bucket_mb": cfg.train.bucket_mb,
         "per_device_batch": cfg.train.per_device_batch,
     }
 

@@ -46,6 +46,15 @@ TPU007 host-in-trace    any import of ``mx_rcnn_tpu.obs`` or
                         best bake trace-time values and at worst sync or
                         do I/O per step.  (TPU006 is the dynamic bf16
                         upcast walk in tools/tpulint.py.)
+TPU008 no-interpret     a ``pallas_call(...)`` without an explicit
+                        ``interpret=`` keyword.  Every Pallas kernel in
+                        this repo must declare its CPU fallback posture
+                        at the call site (threaded from graph.py's
+                        ``_pallas_interpret()`` gate): an implicit
+                        default means the kernel silently fails to lower
+                        off-TPU, and the CI interpret-mode parity suites
+                        (test_roi_align, test_fused_middle) can't reach
+                        it.
 """
 
 from __future__ import annotations
@@ -81,6 +90,9 @@ RULES: dict[str, str] = {
               "(unattributable FLOPs)",
     "TPU007": "mx_rcnn_tpu.obs/ctrl imported in jit-traced code (the "
               "observability and control planes are host-side only)",
+    "TPU008": "pallas_call without an explicit interpret= kwarg (every "
+              "kernel must declare its CPU-fallback posture at the call "
+              "site)",
 }
 
 # Host-only top-level packages TPU007 fences out of traced code.
@@ -347,6 +359,11 @@ class _Linter(ast.NodeVisitor):
                 and not self._in_flax_module()
             ):
                 self._emit("TPU005", node)
+            # TPU008: pallas_call must state its interpret posture.
+            if func.attr == "pallas_call" and not any(
+                kw.arg == "interpret" for kw in node.keywords
+            ):
+                self._emit("TPU008", node)
         self.generic_visit(node)
 
     def visit_BinOp(self, node: ast.BinOp) -> None:

@@ -155,6 +155,24 @@ class RPNConfig:
     # (parallel/step.py::mesh_safe_model_cfg — the packed canvas would
     # concatenate across height shards).
     packed_head: bool = True
+    # Proposal-NMS backend.  "xla" (default) runs the batched while-loop
+    # fixed point (ops/nms.py::nms_mask — the oracle).  "pallas" routes
+    # the keep-mask through ops/pallas/nms.py::nms_mask_pallas, the
+    # VMEM-resident greedy sweep — bit-identical keep bits (parity suite
+    # tests/test_pallas.py / test_fused_middle.py); falls back to "xla"
+    # off-TPU unless MX_RCNN_PALLAS_INTERPRET=1 forces interpret mode.
+    nms_impl: str = "xla"
+    # Fuse the proposal middle — decode -> clip -> snap -> min-size ->
+    # greedy NMS — into ONE Pallas kernel per proposal call
+    # (ops/pallas/middle.py): the per-level score/box tiles stay in VMEM
+    # across the whole chain instead of round-tripping HBM between
+    # ops/proposals.py, ops/topk.py and ops/nms.py as a string of small
+    # XLA programs.  Bit-identical to the dense path (the kernel
+    # replicates decode_boxes/clip_boxes/snap/iou_matrix to the bit and
+    # greedy NMS in top-k positional order provably equals the
+    # argsort-order oracle — docs/performance.md).  Default-off; same
+    # fallback discipline as nms_impl.
+    fused_middle: bool = False
 
 
 @dataclass(frozen=True)
@@ -186,6 +204,14 @@ class RCNNConfig:
     # through the flattened gather — the A/B and debugging escape hatch).
     # The MX_RCNN_POOL_BWD env var still overrides at trace time.
     roi_align_bwd_impl: str = "pallas"
+    # ROI-axis tile for sample_rois' IoU/argmax reductions
+    # (ops/sampling.py::_per_row_stats_blocked, the same machinery as
+    # rpn.assign_block): the (R+G, G) IoU matrix never materializes —
+    # each ROI tile's IoU is computed and reduced in one VMEM-resident
+    # fusion.  Bit-identical to the dense pass (elementwise IoU is
+    # tiling-independent and the per-row max/argmax never cross tiles);
+    # <= 0 (default) restores the single-pass dense form.
+    roi_block: int = 0
 
 
 @dataclass(frozen=True)
@@ -397,6 +423,17 @@ class TrainConfig:
     # Mutually exclusive with steps_per_call>1 and spatial_partition>1.
     # 1 is bit-identical to the plain step.
     accum_steps: int = 1
+    # Bucketed gradient all-reduce (parallel/step.py::_bucketed_pmean):
+    # > 0 splits the single per-step grads pmean into per-bucket pmeans
+    # of ~bucket_mb MiB, grouped in reverse parameter order (the order
+    # backward frames complete) so each bucket's DCN/ICI time can hide
+    # under the remaining backward compute instead of serializing after
+    # it.  Exact: each leaf rides exactly one pmean either way, so the
+    # reduction is bitwise identical to the single fused pmean
+    # (tests/test_fused_middle.py asserts it).  0 (default) keeps the
+    # single-pmean trace — PR 3's bit-exact resume proofs carry over
+    # literally.
+    bucket_mb: int = 0
     momentum: float = 0.9
     weight_decay: float = 1e-4
     grad_clip: float = 35.0  # reference: clip_gradient=5 per-example scale

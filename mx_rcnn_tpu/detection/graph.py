@@ -257,10 +257,44 @@ def _rcnn_losses_impl(cls_logits, box_deltas, samples, class_agnostic: bool):
 
 
 def _propose_one(cfg: ModelConfig, train: bool):
-    """Builds the per-image proposal fn over concatenated level outputs."""
+    """Builds the per-image proposal fn over concatenated level outputs.
+
+    ``rpn.fused_middle``/``rpn.nms_impl`` select the detection-middle
+    backend: the fused Pallas kernel (ops/pallas/middle.py — decode ->
+    clip -> snap -> NMS VMEM-resident, bit-identical to the dense chain),
+    the pallas keep-mask sweep under the dense decode, or the all-XLA
+    oracle.  Same fallback discipline as ``_pool_rois_impl``: pallas
+    backends need a TPU or MX_RCNN_PALLAS_INTERPRET=1; anything else
+    quietly drops to the XLA path (the knobs are default-off, so a
+    fallback can only happen when explicitly requested — warn on TPU,
+    debug-log off it).
+    """
+    global LAST_MIDDLE_IMPL
     rpn_cfg = cfg.rpn
     pre = rpn_cfg.train_pre_nms_top_n if train else rpn_cfg.test_pre_nms_top_n
     post = rpn_cfg.train_post_nms_top_n if train else rpn_cfg.test_post_nms_top_n
+
+    if rpn_cfg.nms_impl not in ("xla", "pallas"):
+        raise ValueError(
+            f"rpn.nms_impl must be 'xla' or 'pallas', got {rpn_cfg.nms_impl!r}"
+        )
+    interpret = _pallas_interpret()
+    can_pallas = jax.default_backend() == "tpu" or interpret
+    want_pallas = rpn_cfg.fused_middle or rpn_cfg.nms_impl == "pallas"
+    if want_pallas and not can_pallas:
+        import logging
+
+        lg = logging.getLogger("mx_rcnn_tpu")
+        (lg.warning if jax.default_backend() == "tpu" else lg.debug)(
+            "rpn fused_middle/nms_impl='pallas' unavailable (backend=%s) "
+            "— using the XLA detection middle",
+            jax.default_backend(),
+        )
+    fused = rpn_cfg.fused_middle and can_pallas
+    nms_impl = rpn_cfg.nms_impl if can_pallas else "xla"
+    LAST_MIDDLE_IMPL = (
+        "fused" if fused else ("pallas-nms" if nms_impl == "pallas" else "xla")
+    )
 
     def single(level_scores, level_deltas, level_anchor, hw) -> Proposals:
         if len(level_scores) == 1:
@@ -276,6 +310,8 @@ def _propose_one(cfg: ModelConfig, train: bool):
                 topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
                 topk_block=rpn_cfg.topk_block,
                 nms_sweep_cap=rpn_cfg.nms_sweep_cap,
+                nms_impl=nms_impl, fused_middle=fused,
+                pallas_interpret=interpret,
             )
         return generate_fpn_proposals(
             level_scores, level_deltas, level_anchor, hw[0], hw[1],
@@ -284,6 +320,8 @@ def _propose_one(cfg: ModelConfig, train: bool):
             topk_impl=rpn_cfg.topk_impl, topk_recall=rpn_cfg.topk_recall,
             topk_block=rpn_cfg.topk_block,
             nms_sweep_cap=rpn_cfg.nms_sweep_cap,
+            nms_impl=nms_impl, fused_middle=fused,
+            pallas_interpret=interpret,
         )
 
     return single
@@ -307,6 +345,11 @@ def _slice_levels(levels, anchors, score_row, delta_row):
 # "pallas-shardmap", or "xla") — set while jit traces, so tests and the
 # driver dryrun can assert which path a compiled program actually took.
 LAST_POOL_IMPL: Optional[str] = None
+
+# Same record for the detection middle (_propose_one): "fused" (the Pallas
+# fused middle), "pallas-nms" (dense decode + pallas keep-mask sweep), or
+# "xla" (the all-XLA oracle / fallback).
+LAST_MIDDLE_IMPL: Optional[str] = None
 
 
 def _pallas_interpret() -> bool:
@@ -641,6 +684,7 @@ def forward_train(model: TwoStageDetector, variables, rng: jax.Array, batch: Bat
                 bg_iou_lo=cfg.rcnn.bg_iou_lo,
                 bbox_weights=cfg.rcnn.bbox_weights,
                 gt_ignore=gi,
+                roi_block=cfg.rcnn.roi_block,
             ),
             in_axes=(0, 0, 0, 0, 0, 0, gi_axis),
         )(

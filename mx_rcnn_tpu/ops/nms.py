@@ -113,25 +113,15 @@ def nms_mask(
     return jnp.zeros(n, dtype=bool).at[order].set(keep_sorted)
 
 
-@partial(jax.jit, static_argnums=(2, 3), static_argnames=("sweep_cap",))
-def nms_indices(
-    boxes: jnp.ndarray,
-    scores: jnp.ndarray,
-    iou_threshold: float,
-    max_outputs: int,
-    valid: jnp.ndarray | None = None,
-    sweep_cap: int = 0,
-):
-    """NMS returning up to ``max_outputs`` kept indices, score-descending.
+def rank_keep(keep: jnp.ndarray, scores: jnp.ndarray, max_outputs: int):
+    """Rank a keep mask by score into up to ``max_outputs`` indices.
 
-    Static output shape: ``(indices (max_outputs,), out_valid (max_outputs,))``.
-    Padded slots hold index 0 with ``out_valid`` False — the static-shape
-    replacement for the reference Proposal op's pad-with-repeats
-    (``rcnn/symbol/proposal.py`` pads rois to RPN_POST_NMS_TOP_N).
+    The back half of :func:`nms_indices`, shared with the fused middle
+    (``ops/pallas/middle.py`` computes the keep mask in-kernel and hands
+    it here): kept entries first, best score first, padded slots index 0
+    with ``out_valid`` False.
     """
-    n = boxes.shape[0]
-    keep = nms_mask(boxes, scores, iou_threshold, valid, sweep_cap=sweep_cap)
-    # Rank kept entries by score; drop the rest to the tail.
+    n = keep.shape[0]
     neg = jnp.where(keep, -scores, jnp.inf)
     order = jnp.argsort(neg)  # kept entries first, best score first
     k = min(n, max_outputs)
@@ -143,6 +133,52 @@ def nms_indices(
         kept = jnp.concatenate([kept, jnp.zeros(pad, bool)])
     out_valid = kept & (jnp.arange(max_outputs) < jnp.sum(keep))
     return jnp.where(out_valid, idx, 0), out_valid
+
+
+@partial(
+    jax.jit,
+    static_argnums=(2, 3),
+    static_argnames=("sweep_cap", "nms_impl", "interpret"),
+)
+def nms_indices(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    max_outputs: int,
+    valid: jnp.ndarray | None = None,
+    sweep_cap: int = 0,
+    nms_impl: str = "xla",
+    interpret: bool = False,
+):
+    """NMS returning up to ``max_outputs`` kept indices, score-descending.
+
+    Static output shape: ``(indices (max_outputs,), out_valid (max_outputs,))``.
+    Padded slots hold index 0 with ``out_valid`` False — the static-shape
+    replacement for the reference Proposal op's pad-with-repeats
+    (``rcnn/symbol/proposal.py`` pads rois to RPN_POST_NMS_TOP_N).
+
+    ``nms_impl`` selects the keep-mask backend: ``"xla"`` (default) is the
+    batched while-loop fixed point above; ``"pallas"`` routes through the
+    VMEM-resident greedy sweep (``ops/pallas/nms.py::nms_mask_pallas``,
+    bit-identical keep bits — it snaps IoU on the same 2**-16 grid before
+    the threshold compare).  The pallas sweep is always-exact greedy, so
+    ``sweep_cap`` does not apply to it (the cap exists to bound the XLA
+    fixed point's data-dependent sweep count).  ``interpret`` runs the
+    pallas kernel in interpret mode (CPU CI).
+    """
+    if nms_impl == "pallas":
+        from mx_rcnn_tpu.ops.pallas.nms import nms_mask_pallas
+
+        keep = nms_mask_pallas(
+            boxes, scores, iou_threshold, valid, interpret=interpret
+        )
+    elif nms_impl == "xla":
+        keep = nms_mask(
+            boxes, scores, iou_threshold, valid, sweep_cap=sweep_cap
+        )
+    else:
+        raise ValueError(f"nms_impl must be 'xla' or 'pallas', got {nms_impl!r}")
+    return rank_keep(keep, scores, max_outputs)
 
 
 def batched_nms(

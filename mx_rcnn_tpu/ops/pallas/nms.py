@@ -59,6 +59,10 @@ def _nms_kernel(data_ref, keep_ref, *, n: int, thresh: float):
         inter = iw * ih
         union = areas + b_area - inter
         iou = jnp.where(union > 0.0, inter / jnp.where(union > 0.0, union, 1.0), 0.0)
+        # Same 2**-16 IoU snap as the XLA oracle (ops/nms.py::nms_mask):
+        # the > threshold compare must make the identical decision on both
+        # backends, including inputs sitting ulps from the threshold.
+        iou = jnp.round(iou * 65536.0) * (1.0 / 65536.0)
 
         suppress = jnp.where((iou > thresh) & (col > i), ai, 0.0)
         return alive * (1.0 - suppress)

@@ -245,6 +245,63 @@ def _per_anchor_stats_blocked(
     )
 
 
+def _per_row_stats_blocked(
+    boxes, row_valid, gt_boxes, gt_valid, gt_ignore, ignore_ioa, block,
+    iou_bits,
+):
+    """Tiled per-row IoU stats for ROI sampling — the :func:`sample_rois`
+    sibling of :func:`_per_anchor_stats_blocked`'s pass 1.
+
+    One ``lax.scan`` over ``block``-row tiles computes each tile's
+    (block, G) IoU/IoA in VMEM and reduces it to ``(max_iou, argmax_gt,
+    in_ignore)`` in the same fusion; the full (N, G) matrices never
+    materialize.  Bit-identical to the dense pass for the same reason the
+    anchor variant is: the elementwise IoU/IoA values don't depend on the
+    tiling, and the max/argmax reductions are per ROW, so they never
+    cross a tile boundary at all.  No second sweep is needed — ROI
+    sampling has no cross-row ``gt_best`` coupling.
+    """
+    n = boxes.shape[0]
+    nb = -(-n // block)
+    pad = nb * block - n
+    bpad = (
+        jnp.concatenate([boxes, jnp.zeros((pad, 4), boxes.dtype)])
+        if pad
+        else boxes
+    )
+    vpad = (
+        jnp.concatenate([row_valid, jnp.zeros(pad, bool)])
+        if pad
+        else row_valid
+    )
+    tiles = bpad.reshape(nb, block, 4)
+    vtiles = vpad.reshape(nb, block)
+    gvf = gt_valid.astype(boxes.dtype)
+
+    def body(carry, xs):
+        bb, vb = xs
+        iou = snap(iou_matrix(bb, gt_boxes), bits=iou_bits) * gvf[None, :]
+        max_iou = jnp.where(vb, jnp.max(iou, axis=1), -1.0)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        if gt_ignore is None:
+            in_ignore = jnp.zeros(bb.shape[0], bool)
+        else:
+            ioa = snap(ioa_matrix(bb, gt_boxes)) * gt_ignore[None, :].astype(
+                bb.dtype
+            )
+            in_ignore = jnp.max(ioa, axis=1) >= ignore_ioa
+        return carry, (max_iou, argmax_gt, in_ignore)
+
+    _, (max_iou, argmax_gt, in_ignore) = lax.scan(
+        body, 0, (tiles, vtiles)
+    )
+
+    def flat(x):
+        return x.reshape(nb * block)[:n]
+
+    return flat(max_iou), flat(argmax_gt), flat(in_ignore)
+
+
 def assign_anchors(
     key: jax.Array,
     anchors: jnp.ndarray,
@@ -360,6 +417,7 @@ def sample_rois(
     bbox_weights: tuple[float, float, float, float] = (10.0, 10.0, 5.0, 5.0),
     gt_ignore: jnp.ndarray | None = None,
     ignore_ioa: float = 0.5,
+    roi_block: int = 0,
 ) -> RoiSamples:
     """Sample proposals into a fixed R-CNN minibatch with targets.
 
@@ -372,21 +430,34 @@ def sample_rois(
 
     ``bbox_weights`` is 1/std of the reference's ``TRAIN.BBOX_NORMALIZATION``
     (targets scaled in-graph; the head's predictions are unscaled at decode).
+
+    ``roi_block`` > 0 tiles the ROI axis so the (R+G, G) IoU/IoA matrices
+    never materialize (:func:`_per_row_stats_blocked` — bit-identical to
+    the dense pass, see its docstring); <= 0 keeps the dense form.
     """
     all_rois = jnp.concatenate([rois, gt_boxes], axis=0)  # (R+G, 4)
     all_valid = jnp.concatenate([roi_valid, gt_valid], axis=0)
 
-    # snap(): fg/bg thresholds and argmax matching below are discrete — keep
-    # them bit-stable across compilations (see geometry.boxes.snap).  bits=8
-    # (IoU grid ~0.004, invisible next to the 0.5/0.3 thresholds): the rois
-    # here are network outputs, so per-program contraction noise is broader
-    # than for constant anchor grids and needs the wider midpoint margin.
-    iou = snap(iou_matrix(all_rois, gt_boxes), bits=8) * gt_valid[None, :].astype(rois.dtype)
-    max_iou = jnp.where(all_valid, jnp.max(iou, axis=1), -1.0)
-    argmax_gt = jnp.argmax(iou, axis=1)
+    # snap() at bits=8 (IoU grid ~0.004, invisible next to the 0.5/0.3
+    # thresholds): fg/bg thresholds and argmax matching below are discrete —
+    # keep them bit-stable across compilations (see geometry.boxes.snap).
+    # The rois here are network outputs, so per-program contraction noise
+    # is broader than for constant anchor grids and needs the wider
+    # midpoint margin.
+    if roi_block and 0 < roi_block < all_rois.shape[0]:
+        max_iou, argmax_gt, in_ignore = _per_row_stats_blocked(
+            all_rois, all_valid, gt_boxes, gt_valid, gt_ignore, ignore_ioa,
+            roi_block, iou_bits=8,
+        )
+    else:
+        iou = snap(iou_matrix(all_rois, gt_boxes), bits=8) * gt_valid[None, :].astype(rois.dtype)
+        max_iou = jnp.where(all_valid, jnp.max(iou, axis=1), -1.0)
+        argmax_gt = jnp.argmax(iou, axis=1)
+        in_ignore = _ignore_overlap_mask(
+            all_rois, gt_boxes, gt_ignore, ignore_ioa
+        )
 
     fg_cand = all_valid & (max_iou >= fg_iou)
-    in_ignore = _ignore_overlap_mask(all_rois, gt_boxes, gt_ignore, ignore_ioa)
     bg_cand = (
         all_valid
         & (max_iou < bg_iou_hi)

@@ -117,6 +117,15 @@ class ExecutionPlan:
     spatial: bool = False
     accum_steps: int = 1
     steps_per_call: int = 1
+    # Gradient-bucket size in MiB for the explicit all-reduce schedule
+    # (parallel/step.py::_bucketed_pmean): grads are grouped in REVERSE
+    # parameter order — the order backward produces them — into ~this
+    # many MiB per bucket, and each bucket rides its own ``pmean`` so
+    # early buckets' collectives overlap the rest of the backward pass.
+    # 0 keeps the single whole-tree reduce (the plain GSPMD trace for
+    # non-accumulated steps — PR 3's bit-exact-resume proofs apply
+    # literally).  Exact either way: every leaf rides exactly one pmean.
+    bucket_mb: int = 0
     # Replica-per-chip serving (serve/fleet.py): pin this plan's programs
     # to ONE device.  jit follows its committed operands, so placement
     # happens through ``place`` (params land on the replica's chip) and
@@ -134,6 +143,18 @@ class ExecutionPlan:
             raise ValueError(
                 f"accum_steps={self.accum_steps} / "
                 f"steps_per_call={self.steps_per_call} must be >= 1"
+            )
+        if self.bucket_mb < 0:
+            raise ValueError(
+                f"bucket_mb={self.bucket_mb} must be >= 0 "
+                "(0 = single whole-tree all-reduce)"
+            )
+        if self.bucket_mb and self.spatial:
+            raise ValueError(
+                "bucket_mb is incompatible with spatial partitioning "
+                "(the overlapped step's shard_map owns the data axis; "
+                "the model axis would be invisible to XLA's spatial "
+                "conv partitioning)"
             )
         if self.accum_steps > 1 and self.steps_per_call > 1:
             raise ValueError(
@@ -161,6 +182,7 @@ class ExecutionPlan:
         spatial: bool = False,
         accum_steps: int = 1,
         steps_per_call: int = 1,
+        bucket_mb: int = 0,
     ) -> "ExecutionPlan":
         """Rules from the model's own family vocabulary (pure DP)."""
         return cls(
@@ -169,6 +191,7 @@ class ExecutionPlan:
             spatial=spatial,
             accum_steps=accum_steps,
             steps_per_call=steps_per_call,
+            bucket_mb=bucket_mb,
         )
 
     # -- properties -------------------------------------------------------
@@ -179,12 +202,28 @@ class ExecutionPlan:
         return self.steps_per_call > 1 or self.accum_steps > 1
 
     @property
+    def overlap_grads(self) -> bool:
+        """The non-accumulated step issues its own bucketed all-reduce
+        schedule (shard_map) instead of leaving the single gradient
+        all-reduce to GSPMD — lets early buckets' collectives overlap
+        the remaining backward computation."""
+        return (
+            self.bucket_mb > 0
+            and self.mesh is not None
+            and self.accum_steps == 1
+            and self.steps_per_call == 1
+        )
+
+    @property
     def use_shard_map(self) -> bool:
         """The step body needs explicit per-shard control: gradient
         accumulation over a data mesh accumulates locally and
         all-reduces once (jit+GSPMD would all-reduce every microbatch
-        of a replicated scan carry)."""
-        return self.accum_steps > 1 and self.mesh is not None
+        of a replicated scan carry), and the bucketed-overlap step
+        issues its own collective schedule."""
+        return (
+            self.accum_steps > 1 or self.overlap_grads
+        ) and self.mesh is not None
 
     @property
     def data_shards(self) -> int:

@@ -32,6 +32,46 @@ from mx_rcnn_tpu.train.state import TrainState, state_variables
 from mx_rcnn_tpu.utils.precision import policy_of
 
 
+def _bucketed_pmean(grads, bucket_mb: int):
+    """All-reduce a gradient pytree in ~``bucket_mb``-MiB buckets.
+
+    Leaves are grouped in REVERSE flatten order — the backbone's deep
+    layers flatten first and backward produces gradients output-to-input,
+    so reversed order is (approximately) completion order.  Each bucket
+    rides its own ``pmean``, so the scheduler can launch the first
+    buckets' collectives while backward is still computing the last —
+    the overlap a single whole-tree reduce structurally forbids (it
+    depends on EVERY leaf).
+
+    Exact: ``pmean`` over a list reduces each leaf independently, so a
+    leaf's value is bit-identical whatever bucket it rides in —
+    bucketed vs single differ only in schedule, never in numerics.
+    ``bucket_mb <= 0`` is the single whole-tree reduce, literally the
+    pre-bucketing trace.
+    """
+    if bucket_mb <= 0:
+        return jax.lax.pmean(grads, DATA_AXIS)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    budget = bucket_mb * (1 << 20)
+    buckets, cur, cur_bytes = [], [], 0
+    for idx in reversed(range(len(leaves))):
+        leaf = leaves[idx]
+        nbytes = leaf.size * jnp.dtype(leaf.dtype).itemsize
+        if cur and cur_bytes + nbytes > budget:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(idx)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    out = [None] * len(leaves)
+    for bucket in buckets:
+        reduced = jax.lax.pmean([leaves[i] for i in bucket], DATA_AXIS)
+        for i, r in zip(bucket, reduced):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def make_train_step(
     model: TwoStageDetector,
     tx: optax.GradientTransformation,
@@ -216,13 +256,14 @@ def make_train_step(
         return grads, metrics
 
     def _accum_psum(params, model_state, batches, a_keys, s_keys):
-        # Per-shard local means, ONE all-reduce per optimizer step — the
-        # reason this is shard_map and not jit+GSPMD (which would
-        # all-reduce the replicated scan carry every microbatch).
+        # Per-shard local means, ONE all-reduce pass per optimizer step
+        # (bucketed when plan.bucket_mb > 0) — the reason this is
+        # shard_map and not jit+GSPMD (which would all-reduce the
+        # replicated scan carry every microbatch).
         grads, metrics = _accum_local(
             params, model_state, batches, a_keys, s_keys
         )
-        grads = jax.lax.pmean(grads, DATA_AXIS)
+        grads = _bucketed_pmean(grads, plan.bucket_mb)
         metrics = jax.lax.pmean(metrics, DATA_AXIS)
         return grads, metrics
 
@@ -254,10 +295,57 @@ def make_train_step(
             )(state.params, state.model_state, batches, a_keys, s_keys)
         return _finish(state, grads, metrics)
 
+    # --- overlapped non-accumulated step (plan.bucket_mb > 0, mesh) -----
+    # The plain jitted step leaves the gradient all-reduce to GSPMD: one
+    # whole-tree collective that depends on every leaf, so nothing moves
+    # over ICI until backward fully finishes.  This variant takes the
+    # per-shard view explicitly (shard_map, like the accumulation path)
+    # and issues _bucketed_pmean's schedule instead — the first buckets'
+    # collectives overlap the rest of backward.  Keys are derived for the
+    # FULL global batch exactly as forward_train's internal split would
+    # (fold_in -> split -> per-image split) and handed in via the rngs
+    # override, so every image samples identically to the plain step.
+
+    def _overlap_psum(params, model_state, batch, a_keys, s_keys):
+        def loss_fn(p):
+            variables = {"params": _masked(p), **model_state}
+            return forward_train(
+                model, variables, None, batch, mesh=None,
+                pixel_stats=pixel_stats, rngs=(a_keys, s_keys),
+            )
+
+        grads, metrics = jax.grad(loss_fn, has_aux=True)(params)
+        grads = _bucketed_pmean(grads, plan.bucket_mb)
+        metrics = jax.lax.pmean(metrics, DATA_AXIS)
+        return grads, metrics
+
+    def overlap_step(state: TrainState, batch: Batch):
+        rng = jax.random.fold_in(state.rng, state.step)
+        rng_assign, rng_sample = jax.random.split(rng)
+        b = batch.images.shape[0]
+        if b % plan.data_shards:
+            raise ValueError(
+                f"batch size {b} not divisible by the data axis "
+                f"({plan.data_shards} shards)"
+            )
+        a_keys = jax.random.split(rng_assign, b)
+        s_keys = jax.random.split(rng_sample, b)
+        kspec = P(DATA_AXIS)
+        grads, metrics = shard_map(
+            _overlap_psum,
+            mesh=mesh,
+            in_specs=(P(), P(), plan.batch_specs(), kspec, kspec),
+            out_specs=(P(), P()),
+            check_rep=False,
+        )(state.params, state.model_state, batch, a_keys, s_keys)
+        return _finish(state, grads, metrics)
+
     if plan.accum_steps > 1:
         fn = accum_step
     elif plan.steps_per_call > 1:
         fn = multi_step
+    elif plan.overlap_grads:
+        fn = overlap_step
     else:
         fn = step
     return plan.compile_step(fn, state_template=state_template)

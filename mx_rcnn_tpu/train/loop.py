@@ -197,6 +197,7 @@ def build_plan(cfg: Config, mesh=None, model: Optional[TwoStageDetector] = None)
         spatial=cfg.train.spatial_partition > 1,
         accum_steps=cfg.train.accum_steps,
         steps_per_call=cfg.train.steps_per_call,
+        bucket_mb=cfg.train.bucket_mb,
     )
 
 

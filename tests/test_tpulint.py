@@ -123,6 +123,23 @@ class TestAstRules:
         src = "from mx_rcnn_tpu import obs\n"
         assert lint_source(HEADER + src, "mx_rcnn_tpu/serve/engine.py") == []
 
+    def test_pallas_call_without_interpret_fires(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "def f(x):\n"
+            "    return pl.pallas_call(kern, out_shape=sh)(x)\n"
+        )
+        assert rules_of(src) == ["TPU008"]
+
+    def test_pallas_call_with_interpret_exempt(self):
+        src = (
+            "from jax.experimental import pallas as pl\n"
+            "def f(x, interpret=False):\n"
+            "    return pl.pallas_call(kern, out_shape=sh, "
+            "interpret=interpret)(x)\n"
+        )
+        assert rules_of(src) == []
+
 
 # ---------------------------------------------------------------------------
 # Baseline ratchet semantics
