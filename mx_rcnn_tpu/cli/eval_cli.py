@@ -398,7 +398,9 @@ def dump_proposals(
                 "boxes": np.asarray(props.rois[i])[valid] / scale,
                 "scores": np.asarray(props.scores[i])[valid],
             }
-    if jax.process_index() == 0:
+    from mx_rcnn_tpu.parallel.distributed import is_primary
+
+    if is_primary():
         with open(out_path, "wb") as f:
             pickle.dump(out, f)
         log.info("wrote %d images' proposals to %s", len(out), out_path)

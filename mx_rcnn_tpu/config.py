@@ -540,6 +540,30 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FabricConfig:
+    """Cross-host serving fabric (serve/rpc.py, serve/gossip.py,
+    serve/gateway.py): one RPC surface per host, health gossip between
+    hosts, and a pod-wide gateway.  All host-side, stdlib-HTTP only."""
+
+    # RPC bind port for this host's fabric endpoint: -1 = fabric off,
+    # 0 = ephemeral (tools/serve_host.py logs the bound port).
+    rpc_port: int = -1
+    # Seconds between gossip rounds (self-refresh + peer exchange).
+    gossip_period_s: float = 0.5
+    # A peer silent this long is SUSPECT; this much longer total, DEAD.
+    suspect_after_s: float = 1.5
+    dead_after_s: float = 4.0
+    # Gateway: seconds before a pending request gets a duplicate on a
+    # second host (None-like <=0 disables cross-host hedging), total
+    # attempt budget per request, consecutive request failures that
+    # quarantine a host, and the quarantined-host probe period.
+    hedge_after_s: float = 0.0
+    max_attempts: int = 2
+    quarantine_failures: int = 2
+    probe_interval_s: float = 0.5
+
+
+@dataclass(frozen=True)
 class Config:
     name: str = "faster_rcnn_r50_fpn_coco"
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -548,6 +572,7 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
     ctrl: CtrlConfig = field(default_factory=CtrlConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fabric: FabricConfig = field(default_factory=FabricConfig)
     workdir: str = "runs"
 
 

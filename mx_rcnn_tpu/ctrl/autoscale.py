@@ -48,6 +48,10 @@ class ScaleSignals:
     queue_depth: int       # total queued across routable replicas
     shed_rate: float       # fleet sheds per second over the window
     p99_s: Optional[float]  # windowed p99 latency (None = no data)
+    # Pod-wide mean load across live hosts, aggregated from gossip
+    # (serve/gossip.py GossipNode.aggregate()).  None on a single-host
+    # deployment — every decision then reads local signals only.
+    pod_mean_load: Optional[float] = None
 
     def as_payload(self) -> dict:
         p = dataclasses.asdict(self)
@@ -55,6 +59,8 @@ class ScaleSignals:
         p["shed_rate"] = round(p["shed_rate"], 3)
         if p["p99_s"] is not None:
             p["p99_s"] = round(p["p99_s"], 4)
+        if p["pod_mean_load"] is not None:
+            p["pod_mean_load"] = round(p["pod_mean_load"], 3)
         return p
 
 
@@ -104,6 +110,11 @@ def desired_action(sig: ScaleSignals,
     if pol.p99_high_s > 0 and sig.p99_s is not None \
             and sig.p99_s > pol.p99_high_s:
         pressure.append(f"p99 {sig.p99_s:.3f}s > {pol.p99_high_s:g}s")
+    if sig.pod_mean_load is not None \
+            and sig.pod_mean_load > pol.load_high:
+        pressure.append(
+            f"pod mean load {sig.pod_mean_load:.2f} > {pol.load_high:g}"
+        )
     if pressure:
         if size >= pol.max_replicas:
             return "hold", (
@@ -117,6 +128,13 @@ def desired_action(sig: ScaleSignals,
         and (
             pol.p99_high_s <= 0 or sig.p99_s is None
             or sig.p99_s <= pol.p99_high_s
+        )
+        # A host never scales down while the pod as a whole is hot:
+        # gossip says peers are loaded, so this host's comfort is
+        # about to end (the gateway rebalances toward it).
+        and (
+            sig.pod_mean_load is None
+            or sig.pod_mean_load < pol.load_low
         )
     )
     if comfortable and sig.building == 0 \
@@ -141,9 +159,14 @@ class Autoscaler:
         registry: Optional[Registry] = None,
         p99_window_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        pod_view: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.fleet = fleet
         self.policy = policy
+        # ``pod_view`` returns a gossip aggregate dict (serve/gossip.py
+        # GossipNode.aggregate) so a host scales on POD pressure, not
+        # just its own — None keeps single-host behaviour bit-for-bit.
+        self.pod_view = pod_view
         self._clock = clock
         self._registry = registry if registry is not None else obs.registry()
         self._window = SnapshotWindow(
@@ -186,6 +209,14 @@ class Autoscaler:
         p99 = merged_percentile(delta, 0.99) if delta else None
         if p99 is not None and p99 == float("inf"):
             p99 = None  # beyond the last bucket: no usable estimate
+        pod_mean = None
+        if self.pod_view is not None:
+            try:
+                agg = self.pod_view() or {}
+                if int(agg.get("hosts", 0)) > 1:
+                    pod_mean = float(agg.get("mean_load", 0.0))
+            except Exception:  # noqa: BLE001 - gossip is advisory
+                log.exception("autoscaler: pod_view failed")
         return ScaleSignals(
             routable=routable,
             building=building,
@@ -193,6 +224,7 @@ class Autoscaler:
             queue_depth=queue,
             shed_rate=shed_rate,
             p99_s=p99,
+            pod_mean_load=pod_mean,
         )
 
     # -- one evaluation ----------------------------------------------------

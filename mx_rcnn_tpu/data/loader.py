@@ -681,6 +681,14 @@ class DetectionLoader:
         idxs, flips = spec
         return self._assemble([self.roidb[j] for j in idxs], flips)
 
+    def _assemble_global_rows(self, spec) -> Batch:
+        """Assemble one GLOBAL (roidb indices, flips) spec by slicing this
+        host's rank rows first.  This is the multi-host service-worker
+        unit of work: the parent ships the full global schedule and each
+        host's workers decode ONLY their rank's rows — bit-identical to
+        parent-side slicing because ``_local_index_spec`` is pure."""
+        return self._assemble_rows(self._local_index_spec(*spec))
+
     def _local_spec_stream(self, skip_batches: int = 0,
                            epochs: Optional[int] = None):
         """Local (indices, flips) specs with resume fast-forward: spec
@@ -695,6 +703,26 @@ class DetectionLoader:
                 return
         for batch_idx, flips in specs:
             yield self._local_index_spec(batch_idx, flips)
+
+    def _global_spec_stream(self, skip_batches: int = 0,
+                            epochs: Optional[int] = None):
+        """GLOBAL (indices, flips) specs as plain ints/bools, with the
+        same resume fast-forward as ``_local_spec_stream``.  This is
+        what ships to service workers on the multi-host path — rank
+        slicing happens worker-side (``_assemble_global_rows``), so a
+        host's decode workers see the full schedule but touch only
+        their rank's pixels."""
+        specs = self._batch_index_specs(epochs)
+        for _ in range(skip_batches):
+            try:
+                next(specs)
+            except StopIteration:
+                return
+        for batch_idx, flips in specs:
+            yield (
+                [int(j) for j in batch_idx],
+                [bool(f) for f in flips],
+            )
 
     def _worker_payload(self) -> dict:
         """Everything a service worker needs to rebuild this loader (spawn
@@ -743,10 +771,17 @@ class DetectionLoader:
             payload += b * self.num_proposals * (4 * 4 + 1)
         return int(payload * 1.25) + HEADER_RESERVE + 4096
 
-    def _service_batches(self, spec_iter, start_index: int = 0):
-        """Run a local spec stream through the process input service
+    def _service_batches(self, spec_iter, start_index: int = 0,
+                         global_specs: bool = False):
+        """Run a spec stream through the process input service
         (data/service.py).  Yields in spec order; closing this generator
-        (or exhausting it) tears the service down."""
+        (or exhausting it) tears the service down.
+
+        ``global_specs=True`` means ``spec_iter`` carries the GLOBAL
+        schedule and workers slice their rank's rows themselves
+        (``_assemble_global_rows``) — the training path.  False keeps
+        pre-sliced LOCAL specs (the eval path, whose sharding already
+        happened upstream)."""
         from mx_rcnn_tpu.data.service import InputService
 
         shm_slots = 0
@@ -754,8 +789,14 @@ class DetectionLoader:
             shm_slots = max(int(getattr(self.cfg, "shm_slots", 4)), 0)
         svc = InputService(
             specs=spec_iter,
-            assemble=self._assemble_rows,
-            builder=_service_assembler,
+            assemble=(
+                self._assemble_global_rows if global_specs
+                else self._assemble_rows
+            ),
+            builder=(
+                _service_assembler_global if global_specs
+                else _service_assembler
+            ),
             payload=self._worker_payload(),
             num_workers=self.service_workers,
             start_index=start_index,
@@ -833,14 +874,22 @@ class DetectionLoader:
     def _raw_train_batches(
         self, skip_batches: int = 0, epochs: Optional[int] = None
     ) -> Iterator[Batch]:
-        specs = self._local_spec_stream(skip_batches, epochs)
         if self.service_workers > 0:
             # Process input service: decode workers as independent failure
             # domains (data/service.py).  start_index keys the service's
             # yield cursor to the GLOBAL batch index so resume and chaos
-            # logs speak the same coordinates as the schedule.
-            yield from self._service_batches(specs, start_index=skip_batches)
-        elif self.num_workers <= 1:
+            # logs speak the same coordinates as the schedule.  The
+            # service ships GLOBAL specs — each host's workers slice
+            # their own rank rows, so every host's parent process emits
+            # one identical schedule and decode is rank-sharded at the
+            # worker (docs/input-service.md, ROADMAP item 2).
+            yield from self._service_batches(
+                self._global_spec_stream(skip_batches, epochs),
+                start_index=skip_batches, global_specs=True,
+            )
+            return
+        specs = self._local_spec_stream(skip_batches, epochs)
+        if self.num_workers <= 1:
             for spec in specs:
                 yield self._assemble_rows(spec)
         else:
@@ -994,6 +1043,17 @@ def _service_assembler(payload: dict):
         quarantine_announced=payload["quarantine_announced"],
     )
     return loader._assemble_rows
+
+
+def _service_assembler_global(payload: dict):
+    """Like :func:`_service_assembler`, but the returned callable takes
+    GLOBAL specs and slices the worker's host-rank rows itself — the
+    payload's ``rank``/``world`` make the rebuilt loader's
+    ``_local_index_spec`` identical to the parent's, so the stream stays
+    bit-identical to parent-side slicing."""
+    assemble_local = _service_assembler(payload)
+    loader = assemble_local.__self__
+    return loader._assemble_global_rows
 
 
 class _Prefetched:

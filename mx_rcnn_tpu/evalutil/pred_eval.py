@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.data.loader import DetectionLoader
+from mx_rcnn_tpu.parallel.distributed import is_primary
 from mx_rcnn_tpu.evalutil.coco_eval import CocoEvaluator
 from mx_rcnn_tpu.evalutil.detections import detections_from_json, save_detections
 from mx_rcnn_tpu.evalutil.voc_eval import voc_mean_ap
@@ -404,9 +405,9 @@ def pred_eval(
         # Multi-host: every host holds the full (gathered) detections and
         # computes identical metrics; artifacts are written once, by
         # process 0.
-        if dump_path and jax.process_index() == 0:
+        if dump_path and is_primary():
             save_detections(dump_path, per_image)
-    if (coco_results_path or voc_dets_dir) and jax.process_index() == 0:
+    if (coco_results_path or voc_dets_dir) and is_primary():
         from mx_rcnn_tpu.evalutil.submission import write_submission_artifacts
 
         write_submission_artifacts(
@@ -417,7 +418,7 @@ def pred_eval(
             class_names=class_names or (),
             voc_imageset=voc_imageset,
         )
-    if vis_dir and jax.process_index() == 0:
+    if vis_dir and is_primary():
         n = visualize_detections(
             per_image, roidb, vis_dir, class_names, count=vis_count
         )

@@ -1,4 +1,4 @@
-"""Stdlib-only HTTP endpoint: /metrics (Prometheus), /healthz, /statusz.
+"""Stdlib-only HTTP endpoint: /metrics, /healthz, /readyz, /statusz.
 
 A ``ThreadingHTTPServer`` on a daemon thread — no new dependencies, no
 interference with process exit.  Port 0 binds an ephemeral port
@@ -60,6 +60,13 @@ class MetricsServer:
                         )
                     elif path == "/healthz":
                         ok, status = outer.health()
+                        self._send(
+                            200 if ok else 503,
+                            (json.dumps(status) + "\n").encode("utf-8"),
+                            "application/json",
+                        )
+                    elif path == "/readyz":
+                        ok, status = outer.readiness()
                         self._send(
                             200 if ok else 503,
                             (json.dumps(status) + "\n").encode("utf-8"),
@@ -133,3 +140,21 @@ class MetricsServer:
         }
         ok = all(alive.values()) if alive else True
         return ok, {"ok": ok, "providers": alive}
+
+    def readiness(self) -> tuple[bool, dict]:
+        """Routability, distinct from liveness: 503 the moment any
+        provider reports ``draining`` True or ``ready`` False, so an
+        external balancer stops sending work while the fleet's exit-75
+        drain completes — the process is still *alive* the whole time."""
+        status = self.status()
+        ready = {
+            name: (
+                bool(snap.get("ready", True))
+                and not bool(snap.get("draining", False))
+                and bool(snap.get("alive", True))
+            )
+            for name, snap in status.items()
+            if isinstance(snap, dict)
+        }
+        ok = all(ready.values()) if ready else True
+        return ok, {"ok": ok, "providers": ready}

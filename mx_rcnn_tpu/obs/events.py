@@ -211,6 +211,44 @@ def _fmt_fleet_replica_retired(p: dict) -> str:
     ).format(**p)
 
 
+def _fmt_peer_suspect(p: dict) -> str:
+    return (
+        "gossip: peer {peer} suspect (incarnation {incarnation}, "
+        "heartbeat {heartbeat})"
+    ).format(**p)
+
+
+def _fmt_peer_dead(p: dict) -> str:
+    return (
+        "gossip: peer {peer} dead (incarnation {incarnation}, "
+        "heartbeat {heartbeat})"
+    ).format(**p)
+
+
+def _fmt_peer_alive(p: dict) -> str:
+    return (
+        "gossip: peer {peer} alive (incarnation {incarnation}, "
+        "heartbeat {heartbeat}, was {was})"
+    ).format(**p)
+
+
+def _fmt_gateway_quarantine(p: dict) -> str:
+    return "gateway: quarantining host {host}: {reason}".format(**p)
+
+
+def _fmt_gateway_reinstate(p: dict) -> str:
+    return (
+        "gateway: host {host} reinstated (generation {generation})"
+    ).format(**p)
+
+
+def _fmt_gateway_weight_roll(p: dict) -> str:
+    return (
+        "gateway: weight roll -> generation {generation} "
+        "({hosts}/{of} host(s) rolled)"
+    ).format(**p)
+
+
 # kind -> (logging level, payload -> line).  Level is the default; emit()
 # callers cannot override the line, only the destination logger.
 EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
@@ -246,6 +284,13 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "slo_burn_stop": (logging.INFO, _fmt_slo_burn_stop),
     "fleet_scale_up": (logging.WARNING, _fmt_fleet_scale_up),
     "fleet_scale_down": (logging.INFO, _fmt_fleet_scale_down),
+    # cross-host fabric (serve/gossip.py, serve/gateway.py)
+    "peer_suspect": (logging.WARNING, _fmt_peer_suspect),
+    "peer_dead": (logging.ERROR, _fmt_peer_dead),
+    "peer_alive": (logging.INFO, _fmt_peer_alive),
+    "gateway_quarantine": (logging.WARNING, _fmt_gateway_quarantine),
+    "gateway_reinstate": (logging.INFO, _fmt_gateway_reinstate),
+    "gateway_weight_roll": (logging.INFO, _fmt_gateway_weight_roll),
     # plane-internal
     "metrics_flush": (logging.DEBUG, _fmt_metrics_flush),
 }

@@ -17,7 +17,7 @@ names decide the layout once, for train, eval, serving, and
 checkpoint-restore alike.
 """
 
-from mx_rcnn_tpu.parallel.distributed import initialize
+from mx_rcnn_tpu.parallel.distributed import initialize, is_primary
 from mx_rcnn_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
@@ -39,6 +39,7 @@ __all__ = [
     "device_prefetch",
     "family_rules",
     "initialize",
+    "is_primary",
     "make_eval_step",
     "make_mesh",
     "make_train_step",

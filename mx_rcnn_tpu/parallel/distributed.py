@@ -104,6 +104,16 @@ def initialize(
     )
 
 
+def is_primary() -> bool:
+    """True on the host that owns shared side effects (checkpoint
+    writes, metric journals, progress logging).  Process 0 by
+    convention — trivially True single-process, and stable for the
+    life of the runtime once :func:`initialize` has run.  Call sites
+    gate on this instead of comparing ``jax.process_index()`` inline
+    so the convention lives in exactly one place."""
+    return jax.process_index() == 0
+
+
 def describe_plan(plan) -> str:
     """One-line placement summary for run-start logs (all hosts see the
     SAME plan by construction — it is a pure function of cfg + mesh, so

@@ -7,6 +7,11 @@ hang watchdog; ``EngineHealth`` exposes the readiness/liveness state
 machine and stats snapshot.  ``FleetRouter`` runs N replica engines as
 independent failure domains: least-loaded routing, hedged retries,
 quarantine/rebuild, zero-downtime weight swap, draining shutdown.
+The cross-host fabric lifts the same shapes one layer up:
+``HostRpcServer``/``RpcClient`` export a host's fleet over stdlib
+HTTP/JSON, ``GossipNode`` exchanges peer health with incarnation-safe
+merges, and ``GatewayRouter`` composes remote host-fleets into one
+pod-wide serving surface with generation-consistent weight rolls.
 """
 
 from mx_rcnn_tpu.serve.batcher import PackBuffer
@@ -29,7 +34,20 @@ from mx_rcnn_tpu.serve.engine import (
     build_engine,
 )
 from mx_rcnn_tpu.serve.fleet import FleetRequest, FleetRouter, build_fleet
+from mx_rcnn_tpu.serve.gateway import (
+    GatewayRequest,
+    GatewayRouter,
+    HostView,
+    select_host,
+)
+from mx_rcnn_tpu.serve.gossip import (
+    GossipNode,
+    PeerState,
+    merge_peer,
+    merge_table,
+)
 from mx_rcnn_tpu.serve.health import EngineHealth
+from mx_rcnn_tpu.serve.rpc import HostRpcServer, HostUnreachable, RpcClient
 from mx_rcnn_tpu.serve.router import (
     DEAD,
     DEGRADED,
@@ -61,6 +79,17 @@ __all__ = [
     "FleetRequest",
     "FleetRouter",
     "build_fleet",
+    "GatewayRequest",
+    "GatewayRouter",
+    "HostView",
+    "select_host",
+    "GossipNode",
+    "PeerState",
+    "merge_peer",
+    "merge_table",
+    "HostRpcServer",
+    "HostUnreachable",
+    "RpcClient",
     "EngineHealth",
     "DEAD",
     "DEGRADED",

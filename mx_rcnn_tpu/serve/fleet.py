@@ -366,16 +366,30 @@ class FleetRouter:
     def infer(self, image, timeout: Optional[float] = None) -> dict:
         return self.submit(image, timeout).result()
 
-    def swap_weights(self, variables) -> int:
+    def swap_weights(self, variables,
+                     generation: Optional[int] = None) -> int:
         """Zero-downtime fleet weight swap: bump the fleet generation,
         then roll the live replicas ONE AT A TIME — each warms the new
         tree on its standby buffer while serving, then flips atomically.
         A replica that fails its swap is quarantined (the supervisor
         rebuilds it straight onto the new generation) and the roll
-        continues.  Returns the new generation."""
+        continues.  Returns the new generation.
+
+        ``generation`` pins the target explicitly (it must advance past
+        the current one) — the cross-host gateway (serve/gateway.py)
+        assigns one pod-wide generation and pushes it to every host so
+        no two hosts ever tag the same weights differently."""
         with self._swap_lock:
             with self._lock:
-                target = self._generation + 1
+                target = (
+                    self._generation + 1 if generation is None
+                    else int(generation)
+                )
+                if target <= self._generation:
+                    raise ValueError(
+                        f"generation must advance: {target} <= "
+                        f"{self._generation}"
+                    )
                 self._weights = variables
                 self._generation = target
                 live = [

@@ -1,0 +1,690 @@
+"""Pod-wide gateway: remote host-fleets composed as failure domains.
+
+:class:`GatewayRouter` is the cross-host sibling of serve/fleet.py's
+FleetRouter, one layer up: where the fleet routes requests across
+replica *engines* in one process, the gateway routes across *hosts* —
+each one a whole FleetRouter reached through its RPC surface
+(serve/rpc.py).  The policy shapes are deliberately the same pure
+forms as serve/router.py, lifted to host granularity:
+
+* **Least-loaded dispatch** over immutable :class:`HostView` snapshots
+  (:func:`select_host`), load = gateway-side inflight + the host's own
+  reported pending work.
+* **Cross-host hedged retries with first-wins dedup**: a straggling
+  request gets a duplicate on a *different host*; the
+  :class:`GatewayRequest` latch accepts exactly one result, losers are
+  discarded (their host-side work completes harmlessly).
+* **Quarantine -> probe -> reinstate** per host: transport failure or a
+  host-level ``EngineUnavailable`` fences the whole host; a background
+  probe loop polls ``/readyz`` and reinstates — after re-pushing the
+  current weights if the host came back on an older generation.
+* **Generation-tagged weight roll**: :meth:`swap_weights` assigns one
+  pod-wide generation, then rolls hosts ONE AT A TIME through their
+  RPC swap endpoint.  Every response carries the generation its
+  replica actually served, so a response is always bitwise old-weights
+  or new-weights — never a mix (chaos scenario ``cross_host_swap``
+  proves this against oracles).
+
+Health input is twofold: the gateway's own request outcomes (fast
+path), and an optional gossip node (serve/gossip.py) whose ``dead``
+verdicts proactively quarantine a host the gateway hasn't talked to
+recently (slow path).  Both converge on the same probe loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Mapping, NamedTuple, Optional, Sequence, Union
+
+from .. import obs
+from .engine import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    ServeError,
+)
+from .router import DEAD, QUARANTINED, READY
+from .rpc import HostUnreachable, RpcClient, encode_tree_leaves
+
+__all__ = ["HostView", "select_host", "GatewayRequest", "GatewayRouter"]
+
+log = logging.getLogger(__name__)
+
+ROUTABLE_HOST = frozenset({READY})
+
+
+class HostView(NamedTuple):
+    """Immutable routing snapshot of one remote host."""
+
+    host_id: str
+    state: str
+    inflight: int      # gateway-side attempts currently on this host
+    reported_load: float  # host's own mean pending work (stats/gossip)
+    generation: int
+
+
+def select_host(
+    views: Sequence[HostView],
+    exclude: frozenset[str] = frozenset(),
+) -> Optional[HostView]:
+    """Least-loaded routable host, or None when the pod cannot serve.
+    ``exclude`` carries hosts a request already tried, so retries and
+    hedges land on fresh failure domains (same contract as
+    serve/router.py::select_replica)."""
+    routable = [
+        v for v in views
+        if v.state in ROUTABLE_HOST and v.host_id not in exclude
+    ]
+    if not routable:
+        return None
+    return min(
+        routable,
+        key=lambda v: (v.inflight + v.reported_load, v.host_id),
+    )
+
+
+class GatewayRequest:
+    """One pod-level request: first-wins result latch across host
+    attempts (the cross-host mirror of serve/fleet.py::FleetRequest)."""
+
+    __slots__ = ("image", "submitted_at", "deadline", "trace_id", "span",
+                 "_lock", "_event", "_result", "_error", "_tried",
+                 "_attempts_started", "_hedged", "_retries", "_on_done")
+
+    def __init__(self, image, submitted_at: float,
+                 deadline: Optional[float]) -> None:
+        self.image = image
+        self.submitted_at = submitted_at
+        self.deadline = deadline
+        self.trace_id: Optional[str] = None
+        self.span = None
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._result: Optional[dict] = None
+        self._error: Optional[BaseException] = None
+        self._tried: set[str] = set()
+        self._attempts_started = 0
+        self._hedged = False
+        self._retries = 0
+        self._on_done: Optional[Callable[[], None]] = None
+
+    def _latch_result(self, result: dict) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._event.set()
+        if self.span is not None:
+            self.span.end(outcome="ok")
+        self._fire_done()
+        return True
+
+    def _latch_error(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._error = error
+            self._event.set()
+        if self.span is not None:
+            self.span.end(error=type(error).__name__)
+        self._fire_done()
+        return True
+
+    def _fire_done(self) -> None:
+        cb = self._on_done
+        self._on_done = None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def tried_hosts(self) -> frozenset[str]:
+        with self._lock:
+            return frozenset(self._tried)
+
+    def remaining(self, now: float) -> Optional[float]:
+        """Budget left, None = unbounded.  <= 0 means the deadline
+        already passed."""
+        if self.deadline is None:
+            return None
+        return self.deadline - now
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        if not self._event.wait(timeout):
+            raise TimeoutError("gateway request not complete")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class _Host:
+    """Mutable gateway-side record for one remote host."""
+
+    __slots__ = ("host_id", "addr", "client", "state", "inflight",
+                 "fail_streak", "reported_load", "generation",
+                 "incarnation", "quarantine_reason")
+
+    def __init__(self, host_id: str, addr: str, client) -> None:
+        self.host_id = host_id
+        self.addr = addr
+        self.client = client
+        self.state = QUARANTINED  # not routable until the first probe
+        self.inflight = 0
+        self.fail_streak = 0
+        self.reported_load = 0.0
+        self.generation = 0
+        self.incarnation = 0
+        self.quarantine_reason = "never probed"
+
+
+class GatewayRouter:
+    """Router + supervisor over N remote host-fleets.
+
+    ``targets``: ``{host_id_hint: addr}`` or a sequence of addrs (the
+    real host id is learned from the first successful probe — the hint
+    only labels logs until then).  Hosts start QUARANTINED and are
+    reinstated by the probe loop, so a gateway pointed at a
+    half-started pod converges instead of crashing.
+    """
+
+    # The RPC surface (serve/rpc.py) forwards wire-form swap leaves
+    # straight through instead of decoding against a local template —
+    # the gateway holds no model of its own.
+    accepts_wire_leaves = True
+
+    def __init__(
+        self,
+        targets: Union[Mapping[str, str], Sequence[str]],
+        *,
+        client_factory: Callable[[str], RpcClient] = RpcClient,
+        hedge_after: Optional[float] = None,
+        max_attempts: int = 2,
+        quarantine_failures: int = 2,
+        probe_interval_s: float = 0.5,
+        default_timeout: Optional[float] = None,
+        gossip=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if isinstance(targets, Mapping):
+            items = list(targets.items())
+        else:
+            items = [(addr, addr) for addr in targets]
+        if not items:
+            raise ValueError("gateway needs at least one target host")
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.hedge_after = hedge_after
+        self.max_attempts = max_attempts
+        self.quarantine_failures = quarantine_failures
+        self.probe_interval_s = float(probe_interval_s)
+        self.default_timeout = default_timeout
+        self.gossip = gossip
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()
+        self._hosts: dict[str, _Host] = {}
+        for hint, addr in items:
+            self._hosts[hint] = _Host(hint, addr, client_factory(addr))
+        self._generation = 0
+        self._last_leaves: Optional[list] = None  # reinstate re-push cache
+        self._started = False
+        self._stopped = False
+        self._draining = False
+        self._pending = 0
+        self._stop_event = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        # Counters (under _lock) — same vocabulary as FleetRouter.stats()
+        # so tools/loadgen.py reads either surface unchanged.
+        self._submitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._shed = 0
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._retries = 0
+        self._quarantines = 0
+        self._reinstatements = 0
+        self._m_requests = obs.counter(
+            "gateway_requests_total", "gateway requests by host and outcome"
+        )
+        self._m_latency = obs.histogram(
+            "gateway_host_latency_seconds", "gateway-observed host latency"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, probe: bool = True) -> "GatewayRouter":
+        """Probe every target once (learning real host ids), then start
+        the background probe loop.  A host that fails its first probe
+        stays quarantined — the loop keeps trying."""
+        self._started = True
+        if probe:
+            for h in list(self._hosts.values()):
+                self._probe_host(h)
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="gateway-probe", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, wait for accepted requests to settle."""
+        self._draining = True
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            with self._lock:
+                if self._pending == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            drained = self._pending == 0
+        return drained
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        # ``timeout`` is accepted for FleetRouter.stop() signature parity
+        # (tools/loadgen.py drives either surface); the probe join below
+        # is already bounded.
+        del timeout
+        self._stopped = True
+        self._stop_event.set()
+        t = self._probe_thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._probe_thread = None
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, image, timeout: Optional[float] = None,
+               trace_id: Optional[str] = None) -> "GatewayRequest":
+        """Route one image to the pod; returns immediately.  Raises
+        :class:`EngineUnavailable` when no host is routable."""
+        if not self._started or self._stopped:
+            raise EngineUnavailable("gateway not started")
+        if self._draining:
+            raise EngineUnavailable("gateway draining")
+        now = self._clock()
+        if timeout is None:
+            timeout = self.default_timeout
+        req = GatewayRequest(
+            image, now, None if timeout is None else now + timeout
+        )
+        req.trace_id = trace_id
+        if obs.spans_enabled():
+            req.span = obs.span(
+                "request", subsystem="gateway", trace_id=trace_id
+            )
+            req.trace_id = req.span.trace_id
+        view = select_host(self.views(), exclude=frozenset())
+        if view is None:
+            with self._lock:
+                self._submitted += 1
+                self._failed += 1
+            self._m_requests.inc(host="-", outcome="unroutable")
+            if req.span is not None:
+                req.span.end(error="EngineUnavailable")
+            raise EngineUnavailable("no routable host in the pod")
+        with self._lock:
+            self._submitted += 1
+            self._pending += 1
+        req._on_done = self._request_done
+        self._launch(req, view.host_id, is_hedge=False)
+        if self.hedge_after is not None:
+            timer = threading.Timer(
+                float(self.hedge_after), self._maybe_hedge, args=(req,)
+            )
+            timer.daemon = True
+            timer.start()
+        if req.deadline is not None:
+            # Backstop: latch DeadlineExceeded even if every attempt
+            # thread is wedged in a socket (slack mirrors RpcClient's).
+            backstop = threading.Timer(
+                max(0.0, req.deadline - now) + 2.5,
+                self._deadline_backstop, args=(req,),
+            )
+            backstop.daemon = True
+            backstop.start()
+        return req
+
+    def infer(self, image, timeout: Optional[float] = None) -> dict:
+        return self.submit(image, timeout).result()
+
+    def _request_done(self) -> None:
+        with self._lock:
+            self._pending -= 1
+
+    def _deadline_backstop(self, req: GatewayRequest) -> None:
+        if not req.done():
+            if req._latch_error(
+                DeadlineExceeded("gateway deadline backstop")
+            ):
+                with self._lock:
+                    self._failed += 1
+                self._m_requests.inc(host="-", outcome="deadline")
+
+    # -- attempts ----------------------------------------------------------
+
+    def _launch(self, req: GatewayRequest, host_id: str,
+                is_hedge: bool) -> None:
+        with self._lock:
+            h = self._hosts.get(host_id)
+            if h is None:
+                return
+            h.inflight += 1
+            req._tried.add(host_id)
+            req._attempts_started += 1
+            if is_hedge:
+                self._hedges += 1
+        threading.Thread(
+            target=self._attempt, args=(req, h, is_hedge),
+            name=f"gw-attempt-{host_id}", daemon=True,
+        ).start()
+
+    def _attempt(self, req: GatewayRequest, h: _Host,
+                 is_hedge: bool) -> None:
+        aspan = None
+        if req.span is not None:
+            aspan = req.span.child("host_attempt", attrs={
+                "host": h.host_id, "hedge": is_hedge,
+                "retry": req._retries,
+            })
+        t0 = self._clock()
+        try:
+            remaining = req.remaining(t0)
+            if remaining is not None and remaining <= 0:
+                raise DeadlineExceeded("budget exhausted before attempt")
+            res = h.client.infer(
+                req.image, deadline_s=remaining, trace_id=req.trace_id,
+            )
+        except ServeError as e:
+            if aspan is not None:
+                aspan.end(error=type(e).__name__)
+            self._m_latency.observe(self._clock() - t0, host=h.host_id)
+            self._attempt_failed(req, h, e, is_hedge)
+        except Exception as e:  # noqa: BLE001 - never lose a request
+            if aspan is not None:
+                aspan.end(error=type(e).__name__)
+            self._attempt_failed(
+                req, h, ServeError(f"{type(e).__name__}: {e}"), is_hedge
+            )
+        else:
+            if aspan is not None:
+                aspan.end(outcome="ok")
+            self._m_latency.observe(self._clock() - t0, host=h.host_id)
+            won = req._latch_result(res)
+            with self._lock:
+                h.inflight -= 1
+                h.fail_streak = 0
+                h.generation = int(res.get("generation", h.generation))
+                if won:
+                    self._completed += 1
+                    if is_hedge:
+                        self._hedge_wins += 1
+            self._m_requests.inc(
+                host=h.host_id, outcome="ok" if won else "dup",
+            )
+
+    def _attempt_failed(self, req: GatewayRequest, h: _Host,
+                        err: ServeError, is_hedge: bool) -> None:
+        name = type(err).__name__
+        host_fault = isinstance(err, (HostUnreachable, EngineUnavailable))
+        with self._lock:
+            h.inflight -= 1
+            if isinstance(err, Overloaded):
+                self._shed += 1
+            elif not host_fault:
+                h.fail_streak += 1
+        self._m_requests.inc(host=h.host_id, outcome=name)
+        if host_fault:
+            self._quarantine(h, name)
+        elif h.fail_streak >= self.quarantine_failures:
+            self._quarantine(h, f"fail streak {h.fail_streak}")
+        if req.done():
+            return
+        # Retry on a fresh host while budget and attempt slots remain.
+        # DeadlineExceeded means the budget itself is gone — latch it.
+        now = self._clock()
+        remaining = req.remaining(now)
+        budget_ok = remaining is None or remaining > 0
+        if (not isinstance(err, DeadlineExceeded) and budget_ok
+                and req._attempts_started < self.max_attempts):
+            view = select_host(self.views(), exclude=req.tried_hosts())
+            if view is not None:
+                with self._lock:
+                    self._retries += 1
+                    req._retries += 1
+                self._launch(req, view.host_id, is_hedge=False)
+                return
+        if req._latch_error(err):
+            with self._lock:
+                self._failed += 1
+
+    def _maybe_hedge(self, req: GatewayRequest) -> None:
+        if req.done() or self._stopped:
+            return
+        with req._lock:
+            if req._hedged:
+                return
+            req._hedged = True
+        now = self._clock()
+        remaining = req.remaining(now)
+        if remaining is not None and remaining <= 0:
+            return
+        view = select_host(self.views(), exclude=req.tried_hosts())
+        if view is None:
+            return
+        self._launch(req, view.host_id, is_hedge=True)
+
+    # -- health ------------------------------------------------------------
+
+    def _quarantine(self, h: _Host, reason: str) -> None:
+        with self._lock:
+            if h.state == QUARANTINED:
+                return
+            h.state = QUARANTINED
+            h.quarantine_reason = reason
+            h.fail_streak = 0
+            self._quarantines += 1
+        obs.emit("fabric", "gateway_quarantine", {
+            "host": h.host_id, "reason": reason,
+        }, logger=log)
+
+    def _reinstate(self, h: _Host) -> None:
+        with self._lock:
+            if h.state == READY:
+                return
+            h.state = READY
+            h.fail_streak = 0
+            self._reinstatements += 1
+        obs.emit("fabric", "gateway_reinstate", {
+            "host": h.host_id, "generation": h.generation,
+        }, logger=log)
+
+    def _probe_loop(self) -> None:
+        while not self._stop_event.wait(self.probe_interval_s):
+            try:
+                self._probe_round()
+            except Exception:  # noqa: BLE001 - the loop must not die
+                log.exception("gateway probe round failed")
+
+    def _probe_round(self) -> None:
+        # Gossip verdicts first: a dead peer is fenced before the
+        # gateway burns a request discovering it.
+        if self.gossip is not None:
+            peers = self.gossip.peers()
+            with self._lock:
+                hosts = list(self._hosts.values())
+            for h in hosts:
+                p = peers.get(h.host_id)
+                if p is None:
+                    continue
+                with self._lock:
+                    h.reported_load = p.load
+                    if p.heartbeat > 0:
+                        h.generation = p.generation
+                        h.incarnation = p.incarnation
+                if p.status == DEAD and h.state == READY:
+                    self._quarantine(h, "gossip dead")
+        with self._lock:
+            quarantined = [
+                h for h in self._hosts.values() if h.state == QUARANTINED
+            ]
+        for h in quarantined:
+            self._probe_host(h)
+
+    def _probe_host(self, h: _Host) -> None:
+        """One probe: stats (identity + load), readiness, generation
+        alignment, then reinstate."""
+        try:
+            info = h.client.stats(timeout_s=2.0)
+        except ServeError:
+            return
+        real_id = str(info.get("host_id", h.host_id))
+        with self._lock:
+            if real_id != h.host_id and real_id not in self._hosts:
+                self._hosts[real_id] = self._hosts.pop(h.host_id)
+                h.host_id = real_id
+            inc = int(info.get("incarnation", 0))
+            rebooted = h.incarnation and inc > h.incarnation
+            h.incarnation = inc
+            h.generation = int(info.get("generation", 0))
+            fleet = info.get("fleet") or {}
+            reps = max(1, int(fleet.get("replicas", 1)))
+            h.reported_load = float(fleet.get("pending", 0)) / reps
+            draining = bool(info.get("draining"))
+            behind = (
+                self._last_leaves is not None
+                and h.generation < self._generation
+            )
+            target_gen = self._generation
+            leaves = self._last_leaves
+        if draining or not fleet.get("replicas", 0):
+            return
+        if rebooted:
+            log.info(
+                "fabric: host %s rebooted (incarnation %d)", h.host_id, inc
+            )
+        if behind and leaves is not None:
+            # Came back on an older generation: align before traffic.
+            try:
+                h.client.swap(leaves, generation=target_gen)
+                with self._lock:
+                    h.generation = target_gen
+            except ServeError as e:
+                log.warning(
+                    "fabric: generation re-push to %s failed: %s",
+                    h.host_id, e,
+                )
+                return
+        self._reinstate(h)
+
+    # -- views / stats -----------------------------------------------------
+
+    def views(self) -> list[HostView]:
+        with self._lock:
+            return [
+                HostView(
+                    host_id=h.host_id, state=h.state, inflight=h.inflight,
+                    reported_load=h.reported_load, generation=h.generation,
+                )
+                for h in self._hosts.values()
+            ]
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def stats(self) -> dict:
+        with self._lock:
+            hosts = {
+                h.host_id: {
+                    "addr": h.addr, "state": h.state,
+                    "inflight": h.inflight,
+                    "reported_load": round(h.reported_load, 3),
+                    "generation": h.generation,
+                    "incarnation": h.incarnation,
+                    "quarantine_reason": (
+                        h.quarantine_reason
+                        if h.state == QUARANTINED else None
+                    ),
+                }
+                for h in self._hosts.values()
+            }
+            routable = sum(
+                1 for h in self._hosts.values() if h.state == READY
+            )
+            return {
+                "hosts": hosts,
+                "replicas": routable,   # routable failure domains
+                "generation": self._generation,
+                "pending": self._pending,
+                "draining": self._draining,
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": self._shed,
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "retries": self._retries,
+                "quarantines": self._quarantines,
+                "reinstatements": self._reinstatements,
+            }
+
+    # -- weight roll -------------------------------------------------------
+
+    def swap_weights(self, variables=None, *,
+                     leaves: Optional[list] = None) -> int:
+        """Pod-wide generation-tagged weight roll.
+
+        The gateway assigns ``generation = current + 1`` and rolls
+        routable hosts ONE AT A TIME through their RPC swap endpoint —
+        each host in turn performs its own replica-at-a-time roll, so
+        at every instant a response is served by weights that are
+        wholly old or wholly new, tagged with the generation that
+        produced it.  A host that fails its swap is quarantined; the
+        probe loop re-pushes the cached leaves before reinstating it.
+        Returns the new pod generation."""
+        if leaves is None:
+            if variables is None:
+                raise ValueError("swap_weights needs variables or leaves")
+            leaves = encode_tree_leaves(variables)
+        with self._swap_lock:
+            with self._lock:
+                target = self._generation + 1
+                self._generation = target
+                self._last_leaves = leaves
+                live = [
+                    h for h in self._hosts.values() if h.state == READY
+                ]
+            rolled = 0
+            for h in live:
+                try:
+                    h.client.swap(leaves, generation=target)
+                    with self._lock:
+                        h.generation = target
+                    rolled += 1
+                except ServeError as e:
+                    log.exception(
+                        "fabric: weight roll failed on host %s", h.host_id
+                    )
+                    self._quarantine(h, f"swap failed: {e}")
+            obs.emit("fabric", "gateway_weight_roll", {
+                "generation": target, "hosts": rolled,
+                "of": len(live),
+            }, logger=log)
+            return target

@@ -1,0 +1,385 @@
+"""Peer health gossip: per-host liveness and load without a master.
+
+Every serving host runs one :class:`GossipNode`.  The node keeps a table
+of :class:`PeerState` entries — one per known host, including itself —
+and on a fixed period (a) refreshes its own entry from a snapshot
+callable, (b) ages remote entries through ``alive -> suspect -> dead``,
+and (c) exchanges tables with each configured peer (push-pull: we POST
+our table, the peer merges it and responds with theirs, we merge that).
+
+Two invariants make the protocol safe under reboots and partitions:
+
+* **Monotonic incarnation numbers.**  A host stamps every snapshot with
+  the incarnation it booted with (wall-clock derived, strictly greater
+  than any previous boot).  :func:`merge_peer` always prefers the higher
+  incarnation, so gossip replaying state about a *previous* life of a
+  rebooted host can never resurrect it as dead/suspect — and a genuinely
+  rebooted host immediately supersedes its own stale entry everywhere.
+* **Heartbeat counters, not wall clocks.**  Within one incarnation the
+  per-host heartbeat counter is the version: higher heartbeat wins, and
+  at equal heartbeat the *worse* status wins (dead > suspect > alive),
+  so a death rumor cannot be shouted down by an equally-old alive entry.
+  Freshness aging uses each receiver's **local** monotonic clock
+  (``last_seen`` is never gossiped), so hosts never compare clocks.
+
+The module is deliberately pure at its core: :func:`merge_peer` and
+:func:`merge_table` are functions over frozen dataclasses, and
+:class:`GossipNode` takes an injectable ``transport`` and ``clock`` so
+every transition is unit-testable without sockets or sleeps.  The real
+transport (HTTP POST /gossip via serve/rpc.py) is wired by the caller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Mapping, Optional, Sequence
+
+from .. import obs
+
+__all__ = [
+    "ALIVE", "SUSPECT", "DEAD",
+    "PeerState", "merge_peer", "merge_table",
+    "GossipNode", "new_incarnation",
+]
+
+log = logging.getLogger(__name__)
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+# Status badness order for equal-version merges: a death rumor at the
+# same (incarnation, heartbeat) beats an alive claim — the pessimistic
+# entry is the one that costs an extra probe, not a lost request.
+_STATUS_RANK = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+
+
+def new_incarnation() -> int:
+    """Boot-scoped incarnation: strictly increases across restarts of the
+    same host id (millisecond wall clock — reboots are never sub-ms)."""
+    return time.time_ns() // 1_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerState:
+    """One host's gossiped view-row.  Everything except ``last_seen`` is
+    exchanged on the wire; ``last_seen`` is the receiver's local
+    monotonic timestamp of the last version bump it observed."""
+
+    host_id: str
+    addr: str
+    incarnation: int
+    heartbeat: int
+    status: str = ALIVE
+    generation: int = 0
+    load: float = 0.0
+    routable: int = 0
+    draining: bool = False
+    last_seen: float = 0.0
+
+    def version(self) -> tuple[int, int]:
+        return (self.incarnation, self.heartbeat)
+
+    def to_wire(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("last_seen")
+        return d
+
+    @classmethod
+    def from_wire(cls, d: Mapping) -> "PeerState":
+        return cls(
+            host_id=str(d["host_id"]),
+            addr=str(d.get("addr", "")),
+            incarnation=int(d["incarnation"]),
+            heartbeat=int(d["heartbeat"]),
+            status=str(d.get("status", ALIVE)),
+            generation=int(d.get("generation", 0)),
+            load=float(d.get("load", 0.0)),
+            routable=int(d.get("routable", 0)),
+            draining=bool(d.get("draining", False)),
+        )
+
+
+def merge_peer(
+    local: Optional[PeerState],
+    incoming: PeerState,
+    now: float,
+) -> PeerState:
+    """Pure merge of one incoming entry against the local one.
+
+    Ordering: higher incarnation wins outright (reboot supersedes every
+    rumor about the previous life); within an incarnation higher
+    heartbeat wins; at an exact version tie the worse status wins.  The
+    winner's ``last_seen`` is refreshed to ``now`` only when the merge
+    actually *advanced* the version — re-hearing an old heartbeat must
+    not keep a silent host alive.
+    """
+    if local is None:
+        return dataclasses.replace(incoming, last_seen=now)
+    if incoming.incarnation != local.incarnation:
+        if incoming.incarnation > local.incarnation:
+            return dataclasses.replace(incoming, last_seen=now)
+        return local
+    if incoming.heartbeat > local.heartbeat:
+        return dataclasses.replace(incoming, last_seen=now)
+    if incoming.heartbeat == local.heartbeat:
+        if _STATUS_RANK.get(incoming.status, 0) > _STATUS_RANK.get(
+            local.status, 0
+        ):
+            # Same version, worse news: adopt the status, keep our clock.
+            return dataclasses.replace(
+                local, status=incoming.status,
+            )
+    return local
+
+
+def merge_table(
+    table: Mapping[str, PeerState],
+    incoming: Sequence[PeerState],
+    now: float,
+    self_id: str,
+) -> dict[str, PeerState]:
+    """Merge a full incoming table.  Entries about ``self_id`` are
+    ignored — a node is always the authority on its own row (it refreshes
+    it with a monotonically increasing heartbeat every tick, so rumors
+    about self can never be newer)."""
+    out = dict(table)
+    for inc in incoming:
+        if inc.host_id == self_id:
+            continue
+        out[inc.host_id] = merge_peer(out.get(inc.host_id), inc, now)
+    return out
+
+
+class GossipNode:
+    """Periodic push-pull gossip + local failure detection for one host.
+
+    ``snapshot_fn`` returns the live local row fields
+    (``{"generation", "load", "routable", "draining"}``); ``transport``
+    is ``(addr, wire_entries) -> wire_entries`` and raises on network
+    failure; ``clock`` is a monotonic float source.  ``start()`` runs
+    :meth:`tick` on a daemon thread; tests call :meth:`tick` directly
+    with a fake clock and transport.
+    """
+
+    def __init__(
+        self,
+        host_id: str,
+        addr: str,
+        snapshot_fn: Callable[[], dict],
+        peers: Optional[Mapping[str, str]] = None,
+        *,
+        period_s: float = 0.5,
+        suspect_after_s: float = 1.5,
+        dead_after_s: float = 4.0,
+        transport: Optional[Callable[[str, list], list]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        incarnation: Optional[int] = None,
+    ) -> None:
+        self.host_id = host_id
+        self.addr = addr
+        self.incarnation = (
+            new_incarnation() if incarnation is None else int(incarnation)
+        )
+        self.period_s = float(period_s)
+        self.suspect_after_s = float(suspect_after_s)
+        self.dead_after_s = float(dead_after_s)
+        self._snapshot_fn = snapshot_fn
+        self._clock = clock
+        self._transport = transport if transport is not None else _http_transport
+        self._lock = threading.Lock()
+        self._heartbeat = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._peer_addrs: dict[str, str] = dict(peers or {})
+        now = self._clock()
+        self._table: dict[str, PeerState] = {
+            host_id: self._self_state(now)
+        }
+        # Seed rows for configured peers so aggregate()/peers() show them
+        # (as not-yet-heard-from alive) before the first exchange lands.
+        for pid, paddr in self._peer_addrs.items():
+            self._table[pid] = PeerState(
+                host_id=pid, addr=paddr, incarnation=0, heartbeat=0,
+                status=ALIVE, last_seen=now,
+            )
+        self._gauge = obs.gauge(
+            "gossip_peers", "gossip peer table size by status"
+        )
+
+    # -- local row ---------------------------------------------------------
+
+    def _self_state(self, now: float) -> PeerState:
+        snap = {}
+        try:
+            snap = dict(self._snapshot_fn() or {})
+        except Exception:  # noqa: BLE001 - gossip must outlive the fleet
+            pass
+        self._heartbeat += 1
+        return PeerState(
+            host_id=self.host_id,
+            addr=self.addr,
+            incarnation=self.incarnation,
+            heartbeat=self._heartbeat,
+            status=ALIVE,
+            generation=int(snap.get("generation", 0)),
+            load=float(snap.get("load", 0.0)),
+            routable=int(snap.get("routable", 0)),
+            draining=bool(snap.get("draining", False)),
+            last_seen=now,
+        )
+
+    # -- protocol ----------------------------------------------------------
+
+    def receive(self, wire_entries: Sequence[Mapping]) -> list[dict]:
+        """Merge an incoming table (the push half of push-pull) and return
+        our table on the wire (the pull half).  This is what the RPC
+        server calls on POST /gossip."""
+        incoming = [PeerState.from_wire(e) for e in wire_entries]
+        now = self._clock()
+        with self._lock:
+            before = {h: p.status for h, p in self._table.items()}
+            self._table = merge_table(
+                self._table, incoming, now, self.host_id
+            )
+            for inc in incoming:  # learn addresses of transitive peers
+                if inc.host_id != self.host_id and inc.addr:
+                    self._peer_addrs.setdefault(inc.host_id, inc.addr)
+            self._emit_transitions(before)
+            return [p.to_wire() for p in self._table.values()]
+
+    def tick(self) -> None:
+        """One gossip round: refresh self, age peers, exchange with every
+        configured peer.  Safe to call concurrently with receive()."""
+        now = self._clock()
+        with self._lock:
+            before = {h: p.status for h, p in self._table.items()}
+            self._table[self.host_id] = self._self_state(now)
+            self._age_locked(now)
+            self._emit_transitions(before)
+            wire = [p.to_wire() for p in self._table.values()]
+            targets = [
+                (h, p.addr or self._peer_addrs.get(h, ""))
+                for h, p in self._table.items()
+                if h != self.host_id and p.status != DEAD
+            ]
+        for host, addr in targets:
+            if not addr:
+                continue
+            try:
+                reply = self._transport(addr, wire)
+            except Exception:  # noqa: BLE001 - unreachable peer ages out
+                continue
+            self.receive(reply)
+        self._export_gauge()
+
+    def _age_locked(self, now: float) -> None:
+        for host, p in list(self._table.items()):
+            if host == self.host_id:
+                continue
+            silent = now - p.last_seen
+            if p.status == ALIVE and silent >= self.suspect_after_s:
+                self._table[host] = dataclasses.replace(p, status=SUSPECT)
+            elif p.status == SUSPECT and silent >= self.dead_after_s:
+                self._table[host] = dataclasses.replace(p, status=DEAD)
+
+    def _emit_transitions(self, before: Mapping[str, str]) -> None:
+        for host, p in self._table.items():
+            if host == self.host_id:
+                continue
+            old = before.get(host)
+            if old == p.status:
+                continue
+            kind = {
+                SUSPECT: "peer_suspect", DEAD: "peer_dead",
+            }.get(p.status, "peer_alive")
+            obs.emit("fabric", kind, {
+                "host": self.host_id, "peer": host,
+                "incarnation": p.incarnation, "heartbeat": p.heartbeat,
+                "was": old,
+            }, logger=log)
+
+    def _export_gauge(self) -> None:
+        counts: dict[str, int] = {ALIVE: 0, SUSPECT: 0, DEAD: 0}
+        for p in self.peers().values():
+            counts[p.status] = counts.get(p.status, 0) + 1
+        for status, n in counts.items():
+            self._gauge.set(n, status=status)
+
+    # -- views -------------------------------------------------------------
+
+    def peers(self) -> dict[str, PeerState]:
+        """Remote rows only (self excluded), as an immutable snapshot."""
+        with self._lock:
+            return {
+                h: p for h, p in self._table.items() if h != self.host_id
+            }
+
+    def table(self) -> dict[str, PeerState]:
+        with self._lock:
+            return dict(self._table)
+
+    def snapshot(self) -> dict:
+        """JSON-able view for /statusz."""
+        now = self._clock()
+        with self._lock:
+            return {
+                "host_id": self.host_id,
+                "incarnation": self.incarnation,
+                "heartbeat": self._heartbeat,
+                "peers": {
+                    h: {**p.to_wire(), "silent_s": round(now - p.last_seen, 3)}
+                    for h, p in self._table.items() if h != self.host_id
+                },
+            }
+
+    def aggregate(self) -> dict:
+        """Pod-wide signal rollup for the ctrl plane: hosts that are
+        routable right now, total routable replicas, mean per-replica
+        load across live hosts, and the highest weight generation seen."""
+        with self._lock:
+            rows = [
+                p for p in self._table.values()
+                if p.status == ALIVE and not p.draining and p.heartbeat > 0
+            ]
+        routable = sum(p.routable for p in rows)
+        loads = [p.load for p in rows if p.routable > 0]
+        return {
+            "hosts": len(rows),
+            "routable": routable,
+            "mean_load": (sum(loads) / len(loads)) if loads else 0.0,
+            "max_generation": max((p.generation for p in rows), default=0),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "GossipNode":
+        self._thread = threading.Thread(
+            target=self._run, name=f"gossip-{self.host_id}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the loop must not die
+                log.exception("gossip tick failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+
+def _http_transport(addr: str, wire_entries: list) -> list:
+    """Default transport: POST /gossip on the peer's RPC server."""
+    from .rpc import RpcClient
+
+    return RpcClient(addr).gossip(wire_entries)

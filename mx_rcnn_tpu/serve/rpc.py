@@ -1,0 +1,483 @@
+"""Stdlib-only HTTP/JSON RPC surface exporting one host's FleetRouter.
+
+This is the network layer of the cross-host serving fabric: each host
+runs a :class:`HostRpcServer` (riding the same ``ThreadingHTTPServer``
+daemon-thread pattern as obs/endpoint.py — no new dependencies), and
+the pod gateway (serve/gateway.py) talks to it through
+:class:`RpcClient` over ``urllib``.  JSON with base64 ndarray leaves is
+deliberately boring: every payload is greppable in a packet capture,
+and the arrays in flight (one image in, a handful of detection arrays
+out) are small enough that codec cost is noise next to inference.
+
+Routes
+======
+
+====================  ====  =======================================
+``/rpc/infer``        POST  run one image through the local fleet;
+                            body carries ``deadline_s`` (remaining
+                            budget, re-derived per hop) + trace ids
+``/rpc/stats``        GET   host identity + ``FleetRouter.stats()``
+``/rpc/swap``         POST  generation-pinned weight swap; leaves
+                            are decoded against the *receiver's* own
+                            template tree (same model + config on
+                            both sides — only data crosses the wire)
+``/rpc/drain``        POST  start a background drain; /readyz flips
+                            503 immediately (exit-75 path)
+``/gossip``           POST  push-pull peer-table exchange
+``/healthz``          GET   liveness (fleet constructed + not dead)
+``/readyz``           GET   routability (503 while draining)
+``/metrics``          GET   the process obs registry
+====================  ====  =======================================
+
+Typed serving errors cross the wire by *name*: the server maps
+``Overloaded``/``DeadlineExceeded``/``EngineUnavailable`` to
+429/504/503 with ``{"ok": false, "error": <name>}`` and the client
+re-raises the matching class, so gateway policy code handles remote
+failures with the exact same ``except`` arms as local ones.  Transport
+failures (refused, reset, timed out) raise :class:`HostUnreachable` —
+the signal that quarantines a whole host rather than one request.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .. import obs
+from .engine import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    Overloaded,
+    ServeError,
+)
+
+__all__ = [
+    "HostUnreachable", "HostRpcServer", "RpcClient",
+    "encode_array", "decode_array", "encode_result", "decode_result",
+    "encode_tree_leaves", "decode_tree_leaves",
+]
+
+log = logging.getLogger(__name__)
+
+
+class HostUnreachable(ServeError):
+    """The host's RPC endpoint could not be reached (network-level
+    failure, not a typed serving error from a live host)."""
+
+
+# HTTP status <-> typed error name.  Anything unlisted is a 500 and
+# comes back as a bare ServeError.
+_ERROR_STATUS = {
+    "Overloaded": 429,
+    "EngineUnavailable": 503,
+    "DeadlineExceeded": 504,
+}
+_ERROR_TYPES = {
+    "Overloaded": Overloaded,
+    "EngineUnavailable": EngineUnavailable,
+    "DeadlineExceeded": DeadlineExceeded,
+}
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def encode_array(arr) -> dict:
+    """ndarray -> JSON-able dict (C-order bytes, base64)."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {
+        "__nd__": True,
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(d: dict) -> np.ndarray:
+    buf = base64.b64decode(d["b64"])
+    return np.frombuffer(buf, dtype=np.dtype(d["dtype"])).reshape(
+        d["shape"]
+    ).copy()
+
+
+def _is_nd(v: Any) -> bool:
+    return isinstance(v, dict) and v.get("__nd__") is True
+
+
+def encode_result(res: dict) -> dict:
+    """Inference result dict -> wire form (arrays encoded, rest as-is)."""
+    return {
+        k: encode_array(v) if isinstance(v, np.ndarray) else v
+        for k, v in res.items()
+    }
+
+
+def decode_result(d: dict) -> dict:
+    return {k: decode_array(v) if _is_nd(v) else v for k, v in d.items()}
+
+
+def encode_tree_leaves(variables) -> list[dict]:
+    """Flatten a weight pytree to encoded leaves in canonical
+    (tree_flatten) order.  The structure itself never crosses the wire:
+    sender and receiver build the same model from the same config, so
+    the receiver re-flattens its *own* template and only the numbers
+    travel."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(variables)
+    return [encode_array(leaf) for leaf in leaves]
+
+
+def decode_tree_leaves(wire_leaves: list, template):
+    """Rebuild a weight pytree from wire leaves using the receiver's
+    ``template`` tree for structure.  Leaf count and shapes must match —
+    a mismatch means the two hosts are not running the same model."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(template)
+    if len(wire_leaves) != len(flat):
+        raise ValueError(
+            f"weight tree mismatch: got {len(wire_leaves)} leaves, "
+            f"template has {len(flat)}"
+        )
+    decoded = []
+    for i, (wire, tmpl) in enumerate(zip(wire_leaves, flat)):
+        arr = decode_array(wire)
+        if tuple(arr.shape) != tuple(np.shape(tmpl)):
+            raise ValueError(
+                f"weight leaf {i} shape mismatch: got {arr.shape}, "
+                f"template {np.shape(tmpl)}"
+            )
+        decoded.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, decoded)
+
+
+# -- server -------------------------------------------------------------------
+
+
+class HostRpcServer:
+    """One host's fabric endpoint: FleetRouter over HTTP/JSON.
+
+    ``weights_template`` (the variables pytree the fleet was built
+    from) enables ``/rpc/swap``; without it the route answers 501.
+    ``gossip`` (a serve/gossip.py GossipNode) enables ``/gossip``.
+    ``on_drain`` is called (once) after a drain request finishes — the
+    serve_host CLI uses it to exit 75.
+    """
+
+    def __init__(
+        self,
+        router,
+        host_id: str,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        gossip=None,
+        weights_template=None,
+        on_drain: Optional[Callable[[bool], None]] = None,
+        incarnation: Optional[int] = None,
+    ) -> None:
+        self.router = router
+        self.host_id = host_id
+        self.gossip = gossip
+        self.weights_template = weights_template
+        self.on_drain = on_drain
+        self.incarnation = (
+            gossip.incarnation if gossip is not None
+            else (0 if incarnation is None else int(incarnation))
+        )
+        self._drain_started = threading.Event()
+        self._requests = obs.counter(
+            "rpc_requests_total", "host RPC requests by route and outcome"
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a) -> None:  # no stderr per request
+                pass
+
+            def _send_json(self, code: int, payload: dict) -> None:
+                body = (json.dumps(payload, default=str) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                if n == 0:
+                    return {}
+                return json.loads(self.rfile.read(n).decode("utf-8"))
+
+            def _route(self, method: str) -> None:
+                path = self.path.split("?", 1)[0]
+                try:
+                    code, payload = outer._dispatch(
+                        method, path, self._body if method == "POST"
+                        else (lambda: {})
+                    )
+                except ServeError as e:
+                    name = type(e).__name__
+                    code = _ERROR_STATUS.get(name, 500)
+                    payload = {"ok": False, "error": name, "detail": str(e)}
+                except Exception as e:  # noqa: BLE001 - RPC must answer
+                    code = 500
+                    payload = {
+                        "ok": False, "error": "ServeError",
+                        "detail": f"{type(e).__name__}: {e}",
+                    }
+                outer._requests.inc(
+                    route=path, outcome="ok" if code < 400 else "error"
+                )
+                try:
+                    self._send_json(code, payload)
+                except OSError:
+                    pass  # client went away mid-response
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                self._route("GET")
+
+            def do_POST(self) -> None:  # noqa: N802 - http.server API
+                self._route("POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.addr = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"rpc-{host_id}", daemon=True,
+        )
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, method: str, path: str,
+                  body_fn: Callable[[], dict]) -> tuple[int, dict]:
+        if method == "POST" and path == "/rpc/infer":
+            return self._infer(body_fn())
+        if method == "GET" and path == "/rpc/stats":
+            return 200, {"ok": True, **self.describe()}
+        if method == "POST" and path == "/rpc/swap":
+            return self._swap(body_fn())
+        if method == "POST" and path == "/rpc/drain":
+            return self._drain(body_fn())
+        if method == "POST" and path == "/gossip":
+            if self.gossip is None:
+                return 501, {"ok": False, "error": "ServeError",
+                             "detail": "gossip not configured"}
+            entries = self.gossip.receive(body_fn().get("entries", []))
+            return 200, {"ok": True, "entries": entries}
+        if method == "GET" and path == "/healthz":
+            return 200, {"ok": True, "host_id": self.host_id}
+        if method == "GET" and path == "/readyz":
+            ready = self.ready()
+            return (200 if ready else 503), {
+                "ok": ready, "host_id": self.host_id,
+                "draining": bool(self.router.stats().get("draining")),
+            }
+        if method == "GET" and path == "/metrics":
+            # Reuse the obs registry render so one port serves scrapes
+            # when the host runs without a separate obs endpoint.
+            return 200, {"ok": True, "metrics": obs.render_metrics()}
+        return 404, {"ok": False, "error": "ServeError",
+                     "detail": f"no route {method} {path}"}
+
+    def _infer(self, body: dict) -> tuple[int, dict]:
+        image = decode_array(body["image"]) if _is_nd(body.get("image")) \
+            else np.asarray(body["image"], dtype=np.uint8)
+        deadline_s = body.get("deadline_s")
+        timeout = float(deadline_s) if deadline_s is not None else None
+        req = self.router.submit(
+            image, timeout=timeout, trace_id=body.get("trace_id"),
+        )
+        res = req.result(timeout)
+        out = encode_result(res)
+        out["host_id"] = self.host_id
+        return 200, {"ok": True, "result": out}
+
+    def _swap(self, body: dict) -> tuple[int, dict]:
+        if getattr(self.router, "accepts_wire_leaves", False):
+            # Gateway behind this surface: forward the wire leaves; the
+            # gateway assigns the pod generation itself.
+            gen = self.router.swap_weights(leaves=body["leaves"])
+            return 200, {"ok": True, "generation": gen}
+        if self.weights_template is None:
+            return 501, {"ok": False, "error": "ServeError",
+                         "detail": "no weights template on this host"}
+        generation = body.get("generation")
+        tree = decode_tree_leaves(body["leaves"], self.weights_template)
+        gen = self.router.swap_weights(
+            tree, generation=None if generation is None else int(generation)
+        )
+        self.weights_template = tree
+        return 200, {"ok": True, "generation": gen}
+
+    def _drain(self, body: dict) -> tuple[int, dict]:
+        timeout = float(body.get("timeout_s", 30.0))
+        if not self._drain_started.is_set():
+            self._drain_started.set()
+
+            def _bg() -> None:
+                ok = self.router.drain(timeout)
+                cb = self.on_drain
+                if cb is not None:
+                    try:
+                        cb(ok)
+                    except Exception:  # noqa: BLE001
+                        log.exception("on_drain callback failed")
+
+            threading.Thread(
+                target=_bg, name=f"rpc-drain-{self.host_id}", daemon=True
+            ).start()
+        return 200, {"ok": True, "draining": True}
+
+    # -- views -------------------------------------------------------------
+
+    def ready(self) -> bool:
+        stats = self.router.stats()
+        return not bool(stats.get("draining")) and bool(
+            stats.get("replicas", 0)
+        )
+
+    def describe(self) -> dict:
+        """Identity + fleet stats — the /rpc/stats body and the local
+        half of the gossip snapshot."""
+        stats = self.router.stats()
+        return {
+            "host_id": self.host_id,
+            "addr": self.addr,
+            "incarnation": self.incarnation,
+            "generation": stats.get("generation", 0),
+            "draining": bool(stats.get("draining")),
+            "fleet": stats,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "HostRpcServer":
+        self._thread.start()
+        log.info("fabric: host %s RPC on %s", self.host_id, self.addr)
+        return self
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+
+
+# -- client -------------------------------------------------------------------
+
+
+class RpcClient:
+    """urllib client for one host's RPC surface.  Every method raises
+    the remote's typed error by name, or :class:`HostUnreachable` when
+    the transport itself fails."""
+
+    def __init__(self, base_url: str, *,
+                 connect_timeout_s: float = 5.0) -> None:
+        if "://" not in base_url:
+            base_url = "http://" + base_url
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout_s = float(connect_timeout_s)
+
+    def _call(self, method: str, path: str,
+              body: Optional[dict] = None,
+              timeout_s: Optional[float] = None) -> dict:
+        url = self.base_url + path
+        data = None
+        headers = {}
+        if method == "POST":
+            data = json.dumps(body or {}).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            url, data=data, headers=headers, method=method
+        )
+        timeout = timeout_s if timeout_s is not None else \
+            self.connect_timeout_s
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                raise ServeError(
+                    f"{url}: HTTP {e.code}"
+                ) from e
+            raise _ERROR_TYPES.get(
+                payload.get("error", ""), ServeError
+            )(payload.get("detail", f"HTTP {e.code}")) from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise HostUnreachable(f"{url}: {e}") from e
+        if not payload.get("ok", False):
+            raise _ERROR_TYPES.get(
+                payload.get("error", ""), ServeError
+            )(payload.get("detail", "remote error"))
+        return payload
+
+    # -- surface -----------------------------------------------------------
+
+    def infer(self, image, *, deadline_s: Optional[float] = None,
+              trace_id: Optional[str] = None) -> dict:
+        """Blocking remote inference.  ``deadline_s`` is the remaining
+        budget — it rides the body (the remote deadline) *and* the
+        socket timeout (plus slack so the remote's own DeadlineExceeded
+        wins the race and comes back typed)."""
+        body: dict = {"image": encode_array(image)}
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
+        if trace_id is not None:
+            body["trace_id"] = trace_id
+        timeout = None if deadline_s is None else deadline_s + 2.0
+        payload = self._call("POST", "/rpc/infer", body, timeout_s=timeout)
+        return decode_result(payload["result"])
+
+    def stats(self, timeout_s: float = 5.0) -> dict:
+        return self._call("GET", "/rpc/stats", timeout_s=timeout_s)
+
+    def swap(self, leaves: list, generation: Optional[int] = None,
+             timeout_s: float = 120.0) -> int:
+        body: dict = {"leaves": leaves}
+        if generation is not None:
+            body["generation"] = int(generation)
+        return int(self._call(
+            "POST", "/rpc/swap", body, timeout_s=timeout_s
+        )["generation"])
+
+    def swap_weights(self, variables, generation: Optional[int] = None,
+                     timeout_s: float = 120.0) -> int:
+        return self.swap(
+            encode_tree_leaves(variables), generation, timeout_s
+        )
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        return self._call(
+            "POST", "/rpc/drain", {"timeout_s": timeout_s},
+            timeout_s=self.connect_timeout_s,
+        )
+
+    def gossip(self, entries: list, timeout_s: float = 5.0) -> list:
+        return self._call(
+            "POST", "/gossip", {"entries": list(entries)},
+            timeout_s=timeout_s,
+        )["entries"]
+
+    def ready(self, timeout_s: float = 5.0) -> bool:
+        try:
+            return bool(self._call(
+                "GET", "/readyz", timeout_s=timeout_s
+            )["ok"])
+        except ServeError:
+            return False

@@ -26,6 +26,7 @@ from mx_rcnn_tpu.detection import TwoStageDetector
 from mx_rcnn_tpu.parallel import (
     PrefetchStats,
     device_prefetch,
+    is_primary,
     make_mesh,
     make_train_step,
 )
@@ -311,7 +312,7 @@ def train(
     :class:`~mx_rcnn_tpu.train.preemption.Preempted` (the CLIs map it to
     the resumable exit code); non-finite metrics trigger the guardian's
     bounded rollback-and-skip, then :class:`TrainingDiverged`."""
-    if cfg.obs.enabled and jax.process_index() == 0:
+    if cfg.obs.enabled and is_primary():
         # Durable observability (docs/observability.md): journal + spans
         # + flight dumps under the run directory (or cfg.obs.dir), plus
         # the optional /metrics endpoint.  Idempotent — a caller that
@@ -410,7 +411,7 @@ def train(
     speedo = Speedometer(global_batch)
     start = int(state.step)
     writer = None
-    if workdir and jax.process_index() == 0:
+    if workdir and is_primary():
         # resume_step truncates rows ahead of the restored step — a crash
         # between checkpoint and metrics flush (or a guardian rollback of a
         # previous run) must not leave duplicate/contradictory rows.

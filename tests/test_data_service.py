@@ -129,6 +129,48 @@ class TestInputService:
         for a, b in zip(ref[5:], got):
             assert_batches_equal(a, b)
 
+    def test_assemble_global_rows_equals_parent_side_slicing(self, rng):
+        """The service ships GLOBAL specs and workers slice their own
+        rank rows (`_assemble_global_rows`); that must be bit-identical
+        to the old parent-side `_local_index_spec` + `_assemble_rows`
+        composition, and the wire form must be plain ints/bools."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        loader = DetectionLoader(
+            roidb, cfg, batch_size=2, seed=3, prefetch=False,
+            num_workers=0, rank=1, world=2,
+        )
+        n = 0
+        for spec, local in zip(
+            loader._global_spec_stream(0, epochs=1),
+            loader._local_spec_stream(0, epochs=1),
+        ):
+            assert all(type(j) is int for j in spec[0])
+            assert all(type(f) is bool for f in spec[1])
+            assert_batches_equal(
+                loader._assemble_global_rows(spec),
+                loader._assemble_rows(local),
+            )
+            n += 1
+        assert n > 0
+
+    def test_multihost_worker_side_slicing_matches_sync(self, rng):
+        """world=2 through the real process service: each rank's worker
+        pool receives the full global schedule, slices its own rows, and
+        the resulting stream is bit-identical to that rank's sync path."""
+        roidb = make_roidb(rng)
+        cfg = make_cfg()
+        for rank in (0, 1):
+            ref = sync_batches(roidb, cfg, epochs=1, rank=rank, world=2)
+            loader = DetectionLoader(
+                roidb, cfg, batch_size=2, seed=3, prefetch=False,
+                num_workers=0, service_workers=2, rank=rank, world=2,
+            )
+            got = list(loader._raw_train_batches(0, epochs=1))
+            assert len(got) == len(ref)
+            for a, b in zip(ref, got):
+                assert_batches_equal(a, b)
+
     def test_worker_sigkill_is_bitwise_invisible(self, rng):
         """SIGKILL a live decode worker mid-stream: its in-flight batches
         are reassigned and the yielded stream stays bit-identical."""

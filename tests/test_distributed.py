@@ -126,6 +126,86 @@ class TestInitializeTriage:
             distributed.initialize()
 
 
+class TestExplicitArgs:
+    def test_explicit_args_forwarded(self, clean_env):
+        stub = _StubDistributed()
+        clean_env.setattr(distributed.jax, "distributed", stub)
+        distributed.initialize(
+            coordinator_address="10.0.0.9:4321",
+            num_processes=8,
+            process_id=3,
+        )
+        assert stub.calls == [
+            dict(
+                coordinator_address="10.0.0.9:4321",
+                num_processes=8,
+                process_id=3,
+            )
+        ]
+
+    def test_explicit_args_override_env(self, clean_env):
+        stub = _StubDistributed()
+        clean_env.setattr(distributed.jax, "distributed", stub)
+        clean_env.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        clean_env.setenv("JAX_NUM_PROCESSES", "4")
+        clean_env.setenv("JAX_PROCESS_ID", "2")
+        distributed.initialize(
+            coordinator_address="10.0.0.9:4321",
+            num_processes=2,
+            process_id=1,
+        )
+        assert stub.calls == [
+            dict(
+                coordinator_address="10.0.0.9:4321",
+                num_processes=2,
+                process_id=1,
+            )
+        ]
+
+    def test_single_process_count_without_address_is_noop(self, clean_env):
+        # num_processes=1 is not a multi-process request: nothing to join.
+        stub = _StubDistributed()
+        clean_env.setattr(distributed.jax, "distributed", stub)
+        distributed.initialize(num_processes=1)
+        assert stub.calls == []
+
+
+class TestIsPrimary:
+    """Process 0 owns shared side effects; every other rank must see
+    False so checkpoint writes, metric journals, and obs configuration
+    stay single-writer (train/loop.py, evalutil/pred_eval.py gate on
+    this helper rather than comparing process_index inline)."""
+
+    def test_true_on_process_zero(self, monkeypatch):
+        monkeypatch.setattr(distributed.jax, "process_index", lambda: 0)
+        assert distributed.is_primary() is True
+
+    def test_false_on_other_ranks(self, monkeypatch):
+        for rank in (1, 3, 7):
+            monkeypatch.setattr(
+                distributed.jax, "process_index", lambda r=rank: r
+            )
+            assert distributed.is_primary() is False
+
+    def test_single_process_is_primary(self):
+        # The conftest world is one process: trivially primary.
+        assert distributed.is_primary() is True
+
+    def test_exported_from_parallel_package(self):
+        from mx_rcnn_tpu import parallel
+
+        assert parallel.is_primary is distributed.is_primary
+
+    def test_gates_artifact_writes_in_pred_eval(self, monkeypatch, tmp_path):
+        # The canonical consumer: a non-primary host must write NO
+        # detection artifacts even when asked to dump them.
+        import importlib
+
+        pe = importlib.import_module("mx_rcnn_tpu.evalutil.pred_eval")
+        monkeypatch.setattr(distributed.jax, "process_index", lambda: 1)
+        assert pe.is_primary() is False
+
+
 class _WorkerFailed(Exception):
     """A worker exited nonzero or timed out (retryable on a loaded host)."""
 

@@ -1,0 +1,1078 @@
+"""Cross-host serving fabric tests (docs/serving.md, "Distributed
+fleet").
+
+The fabric's three layers are each tested at the seam that makes them
+deterministic:
+
+* **RPC** (serve/rpc.py): codec roundtrips are pure; the server is
+  driven over REAL loopback HTTP against a fake fleet, proving the
+  typed-error wire contract (Overloaded/EngineUnavailable/
+  DeadlineExceeded survive the hop by name) and the /readyz drain
+  semantics balancers depend on.
+* **Gossip** (serve/gossip.py): merge_peer/merge_table are pure
+  functions over frozen rows; GossipNode takes an injected clock and
+  transport, so suspect -> dead aging, reboot-supersedes-rumor, and
+  the pod aggregate are all tested without sockets or sleeps.
+* **Gateway** (serve/gateway.py): select_host is pure; GatewayRouter
+  runs against stub RPC clients, proving cross-host failover, hedged
+  first-wins, quarantine -> probe -> reinstate (with generation
+  re-push), and the one-host-at-a-time weight roll.
+
+tools/chaos.py (host_kill / host_partition / cross_host_swap) repeats
+the story against REAL serve_host.py subprocesses with real signals.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.ctrl.autoscale import (
+    Autoscaler,
+    ScalePolicy,
+    ScaleSignals,
+    desired_action,
+)
+from mx_rcnn_tpu.obs.endpoint import MetricsServer
+from mx_rcnn_tpu.obs.metrics import Registry
+from mx_rcnn_tpu.serve import (
+    DeadlineExceeded,
+    EngineUnavailable,
+    GatewayRouter,
+    GossipNode,
+    HostRpcServer,
+    HostUnreachable,
+    Overloaded,
+    PeerState,
+    RpcClient,
+    ServeError,
+    merge_peer,
+    merge_table,
+    select_host,
+)
+from mx_rcnn_tpu.serve.gateway import HostView
+from mx_rcnn_tpu.serve.gossip import ALIVE, DEAD, SUSPECT
+from mx_rcnn_tpu.serve.router import QUARANTINED, READY
+from mx_rcnn_tpu.serve.rpc import (
+    decode_array,
+    decode_result,
+    decode_tree_leaves,
+    encode_array,
+    encode_result,
+    encode_tree_leaves,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# RPC codec (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestCodec:
+    @pytest.mark.parametrize("dtype", ["uint8", "float32", "int32",
+                                       "float64", "bool"])
+    def test_array_roundtrip(self, dtype):
+        rng = np.random.default_rng(0)
+        a = (rng.uniform(0, 100, (3, 5, 2)) > 50).astype(dtype)
+        b = decode_array(encode_array(a))
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert np.array_equal(a, b)
+
+    def test_noncontiguous_input_is_canonicalized(self):
+        a = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+        b = decode_array(encode_array(a))
+        assert np.array_equal(a, b)
+
+    def test_result_roundtrip_mixes_arrays_and_scalars(self):
+        res = {
+            "boxes": np.zeros((2, 4), np.float32),
+            "generation": 3,
+            "level": "full",
+        }
+        out = decode_result(encode_result(res))
+        assert np.array_equal(out["boxes"], res["boxes"])
+        assert out["generation"] == 3 and out["level"] == "full"
+
+    def test_tree_leaves_roundtrip_against_template(self):
+        tree = {"a": np.ones((2, 3), np.float32),
+                "b": {"c": np.arange(4, dtype=np.int32)}}
+        template = {"a": np.zeros((2, 3), np.float32),
+                    "b": {"c": np.zeros(4, np.int32)}}
+        out = decode_tree_leaves(encode_tree_leaves(tree), template)
+        assert np.array_equal(out["a"], tree["a"])
+        assert np.array_equal(out["b"]["c"], tree["b"]["c"])
+
+    def test_tree_leaf_count_mismatch_is_refused(self):
+        tree = {"a": np.ones(3, np.float32)}
+        with pytest.raises(ValueError, match="leaves"):
+            decode_tree_leaves(
+                encode_tree_leaves(tree),
+                {"a": np.zeros(3, np.float32),
+                 "b": np.zeros(3, np.float32)},
+            )
+
+    def test_tree_leaf_shape_mismatch_is_refused(self):
+        tree = {"a": np.ones((2, 3), np.float32)}
+        with pytest.raises(ValueError, match="shape"):
+            decode_tree_leaves(
+                encode_tree_leaves(tree), {"a": np.zeros((3, 2))}
+            )
+
+
+# ---------------------------------------------------------------------------
+# gossip merge (pure)
+# ---------------------------------------------------------------------------
+
+
+def _peer(host="h1", inc=10, hb=5, status=ALIVE, **kw):
+    return PeerState(host_id=host, addr=f"{host}:80", incarnation=inc,
+                     heartbeat=hb, status=status, **kw)
+
+
+class TestMergePeer:
+    def test_unknown_peer_is_adopted_with_local_clock(self):
+        out = merge_peer(None, _peer(), now=42.0)
+        assert out.last_seen == 42.0 and out.status == ALIVE
+
+    def test_higher_incarnation_wins_even_when_older_heartbeat(self):
+        local = _peer(inc=10, hb=100, status=DEAD)
+        incoming = _peer(inc=11, hb=1)  # rebooted host
+        out = merge_peer(local, incoming, now=1.0)
+        assert out.incarnation == 11 and out.status == ALIVE
+
+    def test_lower_incarnation_rumor_cannot_resurrect(self):
+        local = _peer(inc=11, hb=1)
+        out = merge_peer(local, _peer(inc=10, hb=999, status=DEAD), 1.0)
+        assert out.incarnation == 11 and out.status == ALIVE
+
+    def test_higher_heartbeat_wins_and_refreshes_last_seen(self):
+        local = _peer(hb=5, status=SUSPECT)
+        local = merge_peer(None, local, now=0.0)
+        out = merge_peer(local, _peer(hb=6), now=9.0)
+        assert out.heartbeat == 6
+        assert out.status == ALIVE and out.last_seen == 9.0
+
+    def test_stale_heartbeat_does_not_refresh_last_seen(self):
+        local = merge_peer(None, _peer(hb=5), now=0.0)
+        out = merge_peer(local, _peer(hb=5), now=9.0)
+        assert out.last_seen == 0.0  # re-heard, not fresher
+
+    def test_equal_version_worse_status_wins(self):
+        local = merge_peer(None, _peer(hb=5, status=ALIVE), now=0.0)
+        out = merge_peer(local, _peer(hb=5, status=DEAD), now=9.0)
+        assert out.status == DEAD
+        assert out.last_seen == 0.0  # a rumor is not a heartbeat
+
+    def test_equal_version_better_status_is_ignored(self):
+        local = merge_peer(None, _peer(hb=5, status=DEAD), now=0.0)
+        out = merge_peer(local, _peer(hb=5, status=ALIVE), now=9.0)
+        assert out.status == DEAD
+
+    def test_merge_table_ignores_rumors_about_self(self):
+        table = {"me": _peer("me", hb=3)}
+        out = merge_table(
+            table, [_peer("me", hb=99, status=DEAD), _peer("other")],
+            now=1.0, self_id="me",
+        )
+        assert out["me"].heartbeat == 3 and out["me"].status == ALIVE
+        assert "other" in out
+
+    def test_wire_form_drops_local_clock(self):
+        wire = _peer().to_wire()
+        assert "last_seen" not in wire
+        back = PeerState.from_wire(wire)
+        assert back.host_id == "h1" and back.last_seen == 0.0
+
+
+# ---------------------------------------------------------------------------
+# gossip node (fake clock + transport)
+# ---------------------------------------------------------------------------
+
+
+def _node(clock, peers=None, transport=None, snapshot=None, **kw):
+    return GossipNode(
+        "me", "127.0.0.1:1000",
+        snapshot or (lambda: {"generation": 2, "load": 0.5, "routable": 2}),
+        peers=peers or {},
+        period_s=0.1, suspect_after_s=1.0, dead_after_s=3.0,
+        transport=transport or (lambda addr, wire: []),
+        clock=clock, incarnation=77,
+        **kw,
+    )
+
+
+class TestGossipNode:
+    def test_tick_refreshes_own_row_from_snapshot(self):
+        clock = FakeClock()
+        node = _node(clock)
+        node.tick()
+        node.tick()
+        me = node.table()["me"]
+        assert me.heartbeat == 3  # seed row + 2 ticks
+        assert me.incarnation == 77 and me.generation == 2
+        assert me.load == 0.5 and me.routable == 2
+
+    def test_silent_peer_ages_suspect_then_dead(self):
+        clock = FakeClock()
+
+        def unreachable(addr, wire):
+            raise ConnectionError("refused")
+
+        node = _node(clock, peers={"h2": "h2:80"}, transport=unreachable)
+        node.receive([_peer("h2", inc=1, hb=1).to_wire()])
+        assert node.peers()["h2"].status == ALIVE
+        clock.advance(1.5)
+        node.tick()
+        assert node.peers()["h2"].status == SUSPECT
+        clock.advance(3.0)
+        node.tick()
+        assert node.peers()["h2"].status == DEAD
+
+    def test_heartbeat_advance_resets_aging(self):
+        clock = FakeClock()
+        node = _node(clock, peers={"h2": "h2:80"},
+                     transport=lambda a, w: [])
+        node.receive([_peer("h2", inc=1, hb=1).to_wire()])
+        clock.advance(1.5)
+        node.receive([_peer("h2", inc=1, hb=2).to_wire()])  # fresh beat
+        node.tick()
+        assert node.peers()["h2"].status == ALIVE
+
+    def test_exchange_merges_pull_reply_and_learns_addresses(self):
+        clock = FakeClock()
+        reply = [_peer("h3", inc=1, hb=4).to_wire()]
+        calls = []
+
+        def transport(addr, wire):
+            calls.append((addr, [e["host_id"] for e in wire]))
+            return reply
+
+        node = _node(clock, peers={"h2": "h2:80"}, transport=transport)
+        node.tick()
+        assert calls and calls[0][0] == "h2:80"
+        assert "me" in calls[0][1]  # push half carries our own row
+        peers = node.peers()
+        assert peers["h3"].heartbeat == 4  # pull half merged
+        # transitive peer address learned from the merged row
+        clock.advance(0.1)
+        node.tick()
+        assert any(addr == "h3:80" for addr, _ in calls)
+
+    def test_dead_peers_are_not_contacted(self):
+        clock = FakeClock()
+        calls = []
+
+        def transport(addr, wire):
+            calls.append(addr)
+            raise ConnectionError("down")
+
+        node = _node(clock, peers={"h2": "h2:80"}, transport=transport)
+        node.receive([_peer("h2", inc=1, hb=1).to_wire()])
+        clock.advance(1.5)
+        node.tick()
+        clock.advance(3.0)
+        node.tick()  # h2 now dead
+        assert node.peers()["h2"].status == DEAD
+        n = len(calls)
+        clock.advance(0.5)
+        node.tick()
+        assert len(calls) == n  # no further traffic to the dead host
+
+    def test_reboot_supersedes_dead_verdict(self):
+        clock = FakeClock()
+        node = _node(clock, peers={"h2": "h2:80"},
+                     transport=lambda a, w: [])
+        node.receive([_peer("h2", inc=1, hb=9).to_wire()])
+        clock.advance(5.0)
+        node.tick()
+        clock.advance(5.0)
+        node.tick()
+        assert node.peers()["h2"].status == DEAD
+        node.receive([_peer("h2", inc=2, hb=1).to_wire()])  # new life
+        assert node.peers()["h2"].status == ALIVE
+
+    def test_aggregate_counts_only_live_routable_hosts(self):
+        clock = FakeClock()
+        node = _node(clock)
+        node.tick()  # own row: routable 2, load 0.5
+        node.receive([
+            _peer("h2", inc=1, hb=1, load=1.5, routable=2).to_wire(),
+            _peer("h3", inc=1, hb=1, load=9.0, routable=2,
+                  draining=True).to_wire(),          # draining: excluded
+            _peer("h4", inc=1, hb=1, status=DEAD).to_wire(),  # dead
+        ])
+        agg = node.aggregate()
+        assert agg["hosts"] == 2  # me + h2
+        assert agg["routable"] == 4
+        assert agg["mean_load"] == pytest.approx(1.0)
+        assert agg["max_generation"] == 2
+
+    def test_aggregate_ignores_seeded_never_heard_peers(self):
+        clock = FakeClock()
+        node = _node(clock, peers={"h2": "h2:80"},
+                     transport=lambda a, w: [])
+        node.tick()
+        assert node.aggregate()["hosts"] == 1  # h2 heartbeat 0: unproven
+
+    def test_receive_returns_full_table_for_pull_half(self):
+        clock = FakeClock()
+        node = _node(clock)
+        node.tick()
+        wire = node.receive([_peer("h2", inc=1, hb=1).to_wire()])
+        ids = {e["host_id"] for e in wire}
+        assert ids == {"me", "h2"}
+
+    def test_snapshot_reports_silence_age(self):
+        clock = FakeClock()
+        node = _node(clock, peers={"h2": "h2:80"},
+                     transport=lambda a, w: [])
+        node.receive([_peer("h2", inc=1, hb=1).to_wire()])
+        clock.advance(2.5)
+        snap = node.snapshot()
+        assert snap["peers"]["h2"]["silent_s"] == pytest.approx(2.5)
+
+
+# ---------------------------------------------------------------------------
+# gateway policy (pure)
+# ---------------------------------------------------------------------------
+
+
+def _view(host, state=READY, inflight=0, load=0.0, gen=0):
+    return HostView(host_id=host, state=state, inflight=inflight,
+                    reported_load=load, generation=gen)
+
+
+class TestSelectHost:
+    def test_least_combined_load_wins(self):
+        views = [_view("a", inflight=2), _view("b", inflight=0, load=1.0),
+                 _view("c", inflight=1, load=0.5)]
+        assert select_host(views).host_id == "b"
+
+    def test_quarantined_hosts_are_not_routable(self):
+        views = [_view("a", state=QUARANTINED), _view("b", inflight=9)]
+        assert select_host(views).host_id == "b"
+
+    def test_exclude_forces_a_fresh_failure_domain(self):
+        views = [_view("a"), _view("b", inflight=9)]
+        assert select_host(views, frozenset({"a"})).host_id == "b"
+
+    def test_no_routable_host_returns_none(self):
+        assert select_host([_view("a", state=QUARANTINED)]) is None
+        assert select_host([_view("a")], frozenset({"a"})) is None
+
+    def test_tie_breaks_by_host_id(self):
+        assert select_host([_view("b"), _view("a")]).host_id == "a"
+
+
+# ---------------------------------------------------------------------------
+# gateway router over stub clients
+# ---------------------------------------------------------------------------
+
+
+class StubHostClient:
+    """In-memory stand-in for RpcClient: programmable failures, latency
+    and generation, same method surface."""
+
+    def __init__(self, host_id):
+        self.host_id = host_id
+        self.generation = 0
+        self.incarnation = 1
+        self.draining = False
+        self.replicas = 2
+        self.pending = 0
+        self.infer_error = None
+        self.infer_delay = 0.0
+        self.stats_error = None
+        self.swap_error = None
+        self.swap_calls = []
+        self.infer_calls = 0
+
+    def stats(self, timeout_s=5.0):
+        if self.stats_error is not None:
+            raise self.stats_error
+        return {
+            "ok": True, "host_id": self.host_id,
+            "incarnation": self.incarnation,
+            "generation": self.generation, "draining": self.draining,
+            "fleet": {"replicas": self.replicas, "pending": self.pending},
+        }
+
+    def infer(self, image, *, deadline_s=None, trace_id=None):
+        self.infer_calls += 1
+        if self.infer_delay:
+            time.sleep(self.infer_delay)
+        if self.infer_error is not None:
+            raise self.infer_error
+        return {"host_id": self.host_id, "generation": self.generation,
+                "boxes": np.zeros((1, 4), np.float32)}
+
+    def swap(self, leaves, generation=None, timeout_s=120.0):
+        if self.swap_error is not None:
+            raise self.swap_error
+        self.swap_calls.append((len(leaves), generation))
+        self.generation = generation
+        return generation
+
+
+def _gateway(clients, **kw):
+    kw.setdefault("probe_interval_s", 30.0)  # background loop quiet
+    return GatewayRouter(
+        sorted(clients), client_factory=lambda addr: clients[addr], **kw
+    )
+
+
+def _two_hosts():
+    return {"a:1": StubHostClient("hostA"), "b:1": StubHostClient("hostB")}
+
+
+class TestGatewayRouter:
+    def test_start_probes_learn_real_host_ids(self):
+        clients = _two_hosts()
+        gw = _gateway(clients).start()
+        try:
+            s = gw.stats()
+            assert set(s["hosts"]) == {"hostA", "hostB"}
+            assert s["replicas"] == 2
+            assert all(h["state"] == READY for h in s["hosts"].values())
+        finally:
+            gw.stop()
+
+    def test_infer_routes_and_counts(self):
+        clients = _two_hosts()
+        gw = _gateway(clients).start()
+        try:
+            res = gw.infer(np.zeros((4, 4, 3), np.uint8), timeout=30)
+            assert res["host_id"] in ("hostA", "hostB")
+            s = gw.stats()
+            assert s["submitted"] == s["completed"] == 1
+            assert s["failed"] == 0
+        finally:
+            gw.stop()
+
+    def test_host_fault_quarantines_and_fails_over(self):
+        clients = _two_hosts()
+        clients["a:1"].infer_error = HostUnreachable("refused")
+        gw = _gateway(clients).start()
+        try:
+            # Drive enough requests that at least one is routed to the
+            # broken host first (least-loaded may pick either).
+            results = [
+                gw.infer(np.zeros((4, 4, 3), np.uint8), timeout=30)
+                for _ in range(4)
+            ]
+            assert all(r["host_id"] == "hostB" for r in results[-2:])
+            s = gw.stats()
+            assert s["failed"] == 0
+            assert s["quarantines"] >= 1
+            assert s["hosts"]["hostA"]["state"] == QUARANTINED
+        finally:
+            gw.stop()
+
+    def test_failed_probe_keeps_host_quarantined(self):
+        clients = _two_hosts()
+        clients["a:1"].stats_error = HostUnreachable("down")
+        gw = _gateway(clients).start()
+        try:
+            s = gw.stats()
+            # the failing target never learned its real id
+            assert s["hosts"]["a:1"]["state"] == QUARANTINED
+            assert s["hosts"]["hostB"]["state"] == READY
+            assert s["replicas"] == 1
+        finally:
+            gw.stop()
+
+    def test_draining_host_is_not_reinstated(self):
+        clients = _two_hosts()
+        clients["a:1"].draining = True
+        gw = _gateway(clients).start()
+        try:
+            assert gw.stats()["hosts"]["hostA"]["state"] == QUARANTINED
+        finally:
+            gw.stop()
+
+    def test_hedge_first_wins_across_hosts(self):
+        clients = _two_hosts()
+        slow = clients["a:1"]
+        slow.infer_delay = 0.5
+        gw = _gateway(clients, hedge_after=0.05).start()
+        try:
+            # Pin the first attempt onto the slow host by loading B.
+            clients["b:1"].pending = 0
+            reqs = []
+            for _ in range(4):
+                reqs.append(gw.submit(
+                    np.zeros((4, 4, 3), np.uint8), timeout=30
+                ))
+            results = [r.result(timeout=30) for r in reqs]
+            s = gw.stats()
+            assert s["failed"] == 0
+            assert s["hedges"] >= 1
+            assert len(results) == 4
+        finally:
+            gw.stop()
+
+    def test_fail_streak_quarantines_without_host_fault(self):
+        clients = _two_hosts()
+        clients["a:1"].infer_error = ServeError("bad response")
+        gw = _gateway(clients, quarantine_failures=2).start()
+        try:
+            for _ in range(6):
+                gw.infer(np.zeros((4, 4, 3), np.uint8), timeout=30)
+            s = gw.stats()
+            assert s["failed"] == 0  # every request failed over
+            assert s["quarantines"] >= 1
+            assert s["retries"] >= 1
+        finally:
+            gw.stop()
+
+    def test_overload_is_shed_not_quarantine(self):
+        clients = {"a:1": StubHostClient("hostA")}
+        clients["a:1"].infer_error = Overloaded("queue full")
+        gw = _gateway(clients).start()
+        try:
+            with pytest.raises(Overloaded):
+                gw.infer(np.zeros((4, 4, 3), np.uint8), timeout=30)
+            s = gw.stats()
+            assert s["shed"] == 1
+            assert s["hosts"]["hostA"]["state"] == READY  # not fenced
+        finally:
+            gw.stop()
+
+    def test_unroutable_pod_raises_typed(self):
+        clients = _two_hosts()
+        for c in clients.values():
+            c.stats_error = HostUnreachable("down")
+        gw = _gateway(clients).start()
+        try:
+            with pytest.raises(EngineUnavailable):
+                gw.submit(np.zeros((4, 4, 3), np.uint8), timeout=5)
+            assert gw.stats()["failed"] == 1
+        finally:
+            gw.stop()
+
+    def test_draining_gateway_refuses_new_work(self):
+        clients = _two_hosts()
+        gw = _gateway(clients).start()
+        try:
+            assert gw.drain(timeout=5.0)
+            with pytest.raises(EngineUnavailable):
+                gw.submit(np.zeros((4, 4, 3), np.uint8))
+            assert gw.stats()["draining"] is True
+        finally:
+            gw.stop()
+
+    def test_deadline_exhausted_is_typed_and_not_retried(self):
+        clients = _two_hosts()
+        clients["a:1"].infer_error = DeadlineExceeded("over budget")
+        clients["b:1"].infer_error = DeadlineExceeded("over budget")
+        gw = _gateway(clients).start()
+        try:
+            req = gw.submit(np.zeros((4, 4, 3), np.uint8), timeout=30)
+            with pytest.raises(DeadlineExceeded):
+                req.result(timeout=30)
+            assert gw.stats()["failed"] == 1
+        finally:
+            gw.stop()
+
+    def test_weight_roll_is_generation_tagged_one_host_at_a_time(self):
+        clients = _two_hosts()
+        gw = _gateway(clients).start()
+        try:
+            leaves = [{"__nd__": True, "dtype": "float32", "shape": [1],
+                       "b64": "AACAPw=="}]
+            gen = gw.swap_weights(leaves=list(leaves))
+            assert gen == 1 and gw.generation == 1
+            for c in clients.values():
+                assert c.swap_calls == [(1, 1)]
+            assert all(
+                h["generation"] == 1
+                for h in gw.stats()["hosts"].values()
+            )
+        finally:
+            gw.stop()
+
+    def test_failed_roll_quarantines_then_probe_repushes(self):
+        clients = _two_hosts()
+        bad = clients["b:1"]
+        bad.swap_error = ServeError("swap refused")
+        gw = _gateway(clients).start()
+        try:
+            leaves = [{"__nd__": True, "dtype": "float32", "shape": [1],
+                       "b64": "AACAPw=="}]
+            gen = gw.swap_weights(leaves=leaves)
+            s = gw.stats()
+            assert s["hosts"]["hostB"]["state"] == QUARANTINED
+            assert s["hosts"]["hostA"]["generation"] == gen
+            # Host heals: the next probe round must re-push the cached
+            # leaves BEFORE reinstating, so a stale host never serves.
+            bad.swap_error = None
+            gw._probe_round()
+            s = gw.stats()
+            assert s["hosts"]["hostB"]["state"] == READY
+            assert s["hosts"]["hostB"]["generation"] == gen
+            assert bad.swap_calls and bad.swap_calls[-1] == (1, gen)
+        finally:
+            gw.stop()
+
+    def test_gossip_dead_verdict_fences_host(self):
+        clients = _two_hosts()
+
+        class FakeGossip:
+            def peers(self):
+                return {"hostA": _peer("hostA", inc=1, hb=3, status=DEAD)}
+
+        gw = _gateway(clients, gossip=FakeGossip()).start()
+        try:
+            gw._probe_round()
+            s = gw.stats()
+            # probe immediately reinstates (stats still answers), but
+            # the quarantine must have been recorded
+            assert s["quarantines"] >= 1
+        finally:
+            gw.stop()
+
+    def test_gossip_load_feeds_routing_views(self):
+        clients = _two_hosts()
+
+        class FakeGossip:
+            def peers(self):
+                return {"hostA": _peer("hostA", inc=1, hb=3, load=7.5)}
+
+        gw = _gateway(clients, gossip=FakeGossip()).start()
+        try:
+            gw._probe_round()
+            views = {v.host_id: v for v in gw.views()}
+            assert views["hostA"].reported_load == 7.5
+            assert select_host(list(views.values())).host_id == "hostB"
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# host RPC server over real loopback HTTP
+# ---------------------------------------------------------------------------
+
+
+class FakeRequest:
+    def __init__(self, result=None, error=None):
+        self._result = result
+        self._error = error
+
+    def result(self, timeout=None):
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class FakeFleet:
+    """FleetRouter-shaped stub behind a real HostRpcServer."""
+
+    def __init__(self):
+        self.generation = 0
+        self.draining = False
+        self.replicas = 2
+        self.pending = 1
+        self.submit_error = None
+        self.swapped = []
+        self.drain_calls = []
+        self.seen = []
+
+    def submit(self, image, timeout=None, trace_id=None):
+        if self.submit_error is not None:
+            raise self.submit_error
+        img = np.asarray(image)
+        self.seen.append((img.shape, timeout, trace_id))
+        return FakeRequest(result={
+            "boxes": np.full((2, 4), 7, np.float32),
+            "scores": np.asarray([0.9, 0.8], np.float32),
+            "generation": self.generation,
+            "echo_shape": list(img.shape),
+        })
+
+    def stats(self):
+        return {
+            "replicas": self.replicas, "pending": self.pending,
+            "generation": self.generation, "draining": self.draining,
+        }
+
+    def swap_weights(self, tree, generation=None):
+        self.swapped.append((tree, generation))
+        self.generation = (
+            self.generation + 1 if generation is None else int(generation)
+        )
+        return self.generation
+
+    def drain(self, timeout):
+        self.drain_calls.append(timeout)
+        self.draining = True
+        return True
+
+
+@pytest.fixture
+def rpc_pair():
+    fleet = FakeFleet()
+    template = {"w": np.zeros((2, 3), np.float32),
+                "b": np.zeros((3,), np.float32)}
+    server = HostRpcServer(
+        fleet, "hostX", port=0, weights_template=template,
+        incarnation=123,
+    ).start()
+    client = RpcClient(server.addr)
+    yield fleet, server, client
+    server.close()
+
+
+class TestHostRpcServer:
+    def test_infer_roundtrips_arrays_and_tags_host(self, rpc_pair):
+        fleet, _, client = rpc_pair
+        img = np.random.default_rng(0).integers(
+            0, 255, (32, 48, 3), dtype=np.uint8
+        )
+        res = client.infer(img, deadline_s=30.0, trace_id="t-1")
+        assert res["host_id"] == "hostX"
+        assert res["echo_shape"] == [32, 48, 3]
+        assert np.array_equal(res["boxes"], np.full((2, 4), 7, np.float32))
+        # deadline + trace id crossed the wire to the fleet
+        assert fleet.seen[0] == ((32, 48, 3), 30.0, "t-1")
+
+    @pytest.mark.parametrize("exc", [
+        Overloaded("queue full"),
+        EngineUnavailable("all replicas down"),
+        DeadlineExceeded("budget gone"),
+    ])
+    def test_typed_errors_cross_the_wire_by_name(self, rpc_pair, exc):
+        fleet, _, client = rpc_pair
+        fleet.submit_error = exc
+        with pytest.raises(type(exc)):
+            client.infer(np.zeros((4, 4, 3), np.uint8), deadline_s=5.0)
+
+    def test_unreachable_host_is_typed_transport_error(self):
+        client = RpcClient("127.0.0.1:9", connect_timeout_s=0.5)
+        with pytest.raises(HostUnreachable):
+            client.stats(timeout_s=0.5)
+
+    def test_stats_describe_identity(self, rpc_pair):
+        _, server, client = rpc_pair
+        info = client.stats()
+        assert info["host_id"] == "hostX"
+        assert info["incarnation"] == 123
+        assert info["addr"] == server.addr
+        assert info["fleet"]["replicas"] == 2
+
+    def test_swap_decodes_against_receiver_template(self, rpc_pair):
+        fleet, _, client = rpc_pair
+        new = {"w": np.ones((2, 3), np.float32),
+               "b": np.full((3,), 2, np.float32)}
+        gen = client.swap_weights(new, generation=5)
+        assert gen == 5 and fleet.generation == 5
+        tree, pinned = fleet.swapped[0]
+        assert pinned == 5
+        assert np.array_equal(tree["w"], new["w"])
+        assert np.array_equal(tree["b"], new["b"])
+
+    def test_swap_leaf_mismatch_is_a_wire_error(self, rpc_pair):
+        fleet, _, client = rpc_pair
+        with pytest.raises(ServeError):
+            client.swap_weights({"w": np.ones((2, 3), np.float32)})
+        assert not fleet.swapped
+
+    def test_readyz_flips_503_while_draining(self, rpc_pair):
+        fleet, _, client = rpc_pair
+        assert client.ready() is True
+        fleet.draining = True
+        assert client.ready() is False
+
+    def test_readyz_false_with_no_replicas(self, rpc_pair):
+        fleet, _, client = rpc_pair
+        fleet.replicas = 0
+        assert client.ready() is False
+
+    def test_drain_route_fires_on_drain_callback_once(self):
+        fleet = FakeFleet()
+        done = []
+        server = HostRpcServer(
+            fleet, "hostX", port=0, on_drain=done.append
+        ).start()
+        try:
+            client = RpcClient(server.addr)
+            client.drain(timeout_s=5.0)
+            client.drain(timeout_s=5.0)  # idempotent
+            deadline = time.monotonic() + 5.0
+            while not done and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert done == [True]
+            assert len(fleet.drain_calls) == 1
+        finally:
+            server.close()
+
+    def test_gossip_route_exchanges_tables(self):
+        fleet = FakeFleet()
+        clock = FakeClock()
+        node = GossipNode(
+            "hostX", "127.0.0.1:0", lambda: {"routable": 2},
+            period_s=0.1, transport=lambda a, w: [], clock=clock,
+            incarnation=9,
+        )
+        server = HostRpcServer(fleet, "hostX", port=0, gossip=node).start()
+        try:
+            client = RpcClient(server.addr)
+            reply = client.gossip([_peer("h2", inc=1, hb=1).to_wire()])
+            ids = {e["host_id"] for e in reply}
+            assert ids == {"hostX", "h2"}
+            assert node.peers()["h2"].heartbeat == 1
+        finally:
+            server.close()
+
+    def test_gossip_route_without_node_is_an_error(self, rpc_pair):
+        _, _, client = rpc_pair
+        with pytest.raises(ServeError):
+            client.gossip([])
+
+
+# ---------------------------------------------------------------------------
+# obs /readyz endpoint (satellite: drain visibility)
+# ---------------------------------------------------------------------------
+
+
+class TestObsReadiness:
+    def _server(self):
+        return MetricsServer(Registry(), port=0)
+
+    def test_ready_by_default_and_with_healthy_providers(self):
+        srv = self._server()
+        srv.register_status("fleet", lambda: {"pending": 0})
+        ok, status = srv.readiness()
+        assert ok and status["providers"] == {"fleet": True}
+
+    def test_draining_provider_flips_not_ready(self):
+        srv = self._server()
+        srv.register_status("fleet", lambda: {"draining": True})
+        ok, status = srv.readiness()
+        assert not ok and status["providers"]["fleet"] is False
+
+    def test_explicit_ready_false_flips_not_ready(self):
+        srv = self._server()
+        srv.register_status("fleet", lambda: {"ready": False})
+        assert srv.readiness()[0] is False
+
+    def test_dead_provider_is_not_ready_but_draining_is_alive(self):
+        srv = self._server()
+        srv.register_status("fleet", lambda: {"alive": False})
+        assert srv.readiness()[0] is False
+        # liveness and readiness diverge during drain: alive, not ready
+        srv.register_status("fleet", lambda: {"alive": True,
+                                              "draining": True})
+        assert srv.health()[0] is True
+        assert srv.readiness()[0] is False
+
+    def test_http_readyz_is_503_while_draining(self):
+        import urllib.error
+        import urllib.request
+
+        srv = self._server().start()
+        try:
+            state = {"draining": False}
+            srv.register_status("fleet", lambda: dict(state))
+            url = f"http://127.0.0.1:{srv.port}/readyz"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+            state["draining"] = True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler pod signals (ctrl wiring)
+# ---------------------------------------------------------------------------
+
+
+def _sig(**kw):
+    base = dict(routable=2, building=0, mean_load=0.2, queue_depth=0,
+                shed_rate=0.0, p99_s=None, pod_mean_load=None)
+    base.update(kw)
+    return ScaleSignals(**base)
+
+
+class TestPodSignals:
+    POL = ScalePolicy(min_replicas=1, max_replicas=4,
+                      load_high=4.0, load_low=0.5)
+
+    def test_pod_pressure_scales_up_a_comfortable_host(self):
+        action, reason = desired_action(
+            _sig(pod_mean_load=9.0), self.POL
+        )
+        assert action == "up" and "pod mean load" in reason
+
+    def test_hot_pod_blocks_local_scale_down(self):
+        action, _ = desired_action(_sig(pod_mean_load=2.0), self.POL)
+        assert action == "hold"  # comfortable locally, pod in band
+
+    def test_cool_pod_allows_scale_down(self):
+        action, _ = desired_action(_sig(pod_mean_load=0.1), self.POL)
+        assert action == "down"
+
+    def test_single_host_behaviour_unchanged(self):
+        assert desired_action(_sig(), self.POL)[0] == "down"
+        assert desired_action(
+            _sig(mean_load=9.0, pod_mean_load=None), self.POL
+        )[0] == "up"
+
+    def test_payload_includes_pod_mean(self):
+        p = _sig(pod_mean_load=1.23456).as_payload()
+        assert p["pod_mean_load"] == 1.235
+        assert _sig().as_payload()["pod_mean_load"] is None
+
+
+class _ScalerFleet:
+    def stats(self):
+        return {
+            "replica": [
+                {"state": READY, "inflight": 0,
+                 "engine": {"queue_depth": 0}},
+            ],
+            "shed": 0,
+        }
+
+
+class TestAutoscalerPodView:
+    def test_pod_view_feeds_signals_when_pod_has_peers(self):
+        scaler = Autoscaler(
+            _ScalerFleet(), ScalePolicy(), registry=Registry(),
+            pod_view=lambda: {"hosts": 3, "mean_load": 2.5},
+        )
+        assert scaler.signals().pod_mean_load == 2.5
+
+    def test_single_host_aggregate_disables_pod_signal(self):
+        scaler = Autoscaler(
+            _ScalerFleet(), ScalePolicy(), registry=Registry(),
+            pod_view=lambda: {"hosts": 1, "mean_load": 2.5},
+        )
+        assert scaler.signals().pod_mean_load is None
+
+    def test_pod_view_failure_is_advisory(self):
+        def boom():
+            raise RuntimeError("gossip down")
+
+        scaler = Autoscaler(
+            _ScalerFleet(), ScalePolicy(), registry=Registry(),
+            pod_view=boom,
+        )
+        sig = scaler.signals()
+        assert sig.pod_mean_load is None and sig.routable == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real server pair behind a real gateway (in-process hosts)
+# ---------------------------------------------------------------------------
+
+
+class TestFabricLoopback:
+    """Two FakeFleet hosts behind REAL RPC servers, composed by a real
+    GatewayRouter — every hop over loopback HTTP."""
+
+    def test_gateway_over_two_real_rpc_hosts(self):
+        fleets = {"hostA": FakeFleet(), "hostB": FakeFleet()}
+        servers = [
+            HostRpcServer(fleets["hostA"], "hostA", port=0).start(),
+            HostRpcServer(fleets["hostB"], "hostB", port=0).start(),
+        ]
+        gw = GatewayRouter(
+            [s.addr for s in servers], probe_interval_s=0.1,
+        ).start()
+        try:
+            assert gw.stats()["replicas"] == 2
+            img = np.zeros((8, 8, 3), np.uint8)
+            hosts_seen = set()
+            for _ in range(6):
+                hosts_seen.add(gw.infer(img, timeout=30)["host_id"])
+            assert hosts_seen <= {"hostA", "hostB"}
+            s = gw.stats()
+            assert s["completed"] == 6 and s["failed"] == 0
+        finally:
+            gw.stop()
+            for srv in servers:
+                srv.close()
+
+    def test_killing_a_real_server_fails_over_and_reinstates(self):
+        fleets = {"hostA": FakeFleet(), "hostB": FakeFleet()}
+        servers = {
+            h: HostRpcServer(f, h, port=0).start()
+            for h, f in fleets.items()
+        }
+        gw = GatewayRouter(
+            [servers["hostA"].addr, servers["hostB"].addr],
+            probe_interval_s=0.1,
+        ).start()
+        try:
+            assert gw.stats()["replicas"] == 2
+            dead_addr = servers["hostA"].addr
+            servers["hostA"].close()  # the host process "dies"
+            img = np.zeros((8, 8, 3), np.uint8)
+            for _ in range(4):
+                res = gw.infer(img, timeout=30)
+                assert res["host_id"] == "hostB"
+            s = gw.stats()
+            assert s["failed"] == 0 and s["quarantines"] >= 1
+            assert s["hosts"]["hostA"]["state"] == QUARANTINED
+            # host comes back on the same address: probe reinstates
+            host, port = dead_addr.rsplit(":", 1)
+            revived = HostRpcServer(
+                fleets["hostA"], "hostA", port=int(port), host=host,
+            ).start()
+            try:
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if gw.stats()["hosts"]["hostA"]["state"] == READY:
+                        break
+                    time.sleep(0.05)
+                assert gw.stats()["hosts"]["hostA"]["state"] == READY
+                assert gw.stats()["reinstatements"] >= 1
+            finally:
+                revived.close()
+        finally:
+            gw.stop()
+            for srv in servers.values():
+                srv.close()
+
+    def test_pod_roll_through_real_wire(self):
+        fleets = {"hostA": FakeFleet(), "hostB": FakeFleet()}
+        template = {"w": np.zeros((2, 2), np.float32)}
+        servers = {
+            h: HostRpcServer(
+                f, h, port=0, weights_template=dict(template)
+            ).start()
+            for h, f in fleets.items()
+        }
+        gw = GatewayRouter(
+            [servers["hostA"].addr, servers["hostB"].addr],
+            probe_interval_s=0.1,
+        ).start()
+        try:
+            assert gw.stats()["replicas"] == 2
+            gen = gw.swap_weights({"w": np.ones((2, 2), np.float32)})
+            assert gen == 1
+            for f in fleets.values():
+                tree, pinned = f.swapped[0]
+                assert pinned == 1
+                assert np.array_equal(
+                    tree["w"], np.ones((2, 2), np.float32)
+                )
+        finally:
+            gw.stop()
+            for srv in servers.values():
+                srv.close()

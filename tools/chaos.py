@@ -82,6 +82,27 @@ Fleet scenarios (serve/fleet.py) — real fleets on 4 fake CPU devices
                   requests lost — and the full resize story replays
                   from the obs journal.
 
+Cross-host fabric scenarios (serve/rpc.py, serve/gossip.py,
+serve/gateway.py) — REAL multi-process fleets: each host is a
+tools/serve_host.py subprocess (its own interpreter, devices and RPC
+port); the chaos child drives them through a real GatewayRouter:
+
+  host_kill        SIGKILL one of two host processes mid-load through
+                   the gateway: zero accepted-request loss (cross-host
+                   retry), gossip flags the host dead, the gateway
+                   quarantines it and rebalances onto the survivor —
+                   which then drains on SIGTERM and exits 75.
+  host_partition   SIGSTOP a host (alive but silent — a network
+                   partition, not a crash): gossip walks it through
+                   suspect -> dead, the gateway fences it, traffic
+                   keeps completing on the peer; SIGCONT heals the
+                   partition and the probe loop reinstates the host.
+  cross_host_swap  pod-wide generation-tagged weight roll under load:
+                   every response from EITHER host bitwise-matches the
+                   oracle for the generation it reports — proving hosts
+                   serve identical weights per generation and no
+                   response ever mixes generations.
+
 Bit-identity holds because recovery re-runs the same compiled program
 over the same data schedule from the same restored state — it is the
 strongest possible "nothing was lost, nothing was double-applied" check
@@ -93,7 +114,9 @@ Usage:
                                     |cache_corrupt|data_service_dead
                                     |eval_sigkill|eval_corrupt|overload|hang
                                     |replica_kill|replica_wedge
-                                    |swap_under_load|fleet_drain]
+                                    |swap_under_load|fleet_drain|fleet_scale
+                                    |host_kill|host_partition
+                                    |cross_host_swap]
                         [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
                         [--scenario-timeout SECONDS]
 
@@ -639,6 +662,401 @@ def child_fleet_scale_main() -> int:
     assert s["replicas"] == 2, s
     if obs_dir:
         obs.close()
+    return 0
+
+
+# -- cross-host fabric children ----------------------------------------------
+
+
+SERVE_HOST = os.path.join(REPO_ROOT, "tools", "serve_host.py")
+
+
+class _FabricHost:
+    """One tools/serve_host.py subprocess — a REAL host: its own
+    interpreter, fake devices, fleet, RPC port and gossip node.
+    Readiness (and the ephemeral port) is parsed from its log."""
+
+    def __init__(self, workdir: str, host_id: str, *, replicas: int = 2,
+                 seed: int = 0, peers: str = "") -> None:
+        os.makedirs(workdir, exist_ok=True)
+        self.host_id = host_id
+        self.log_path = os.path.join(workdir, f"{host_id}.log")
+        self._log = open(self.log_path, "a")
+        argv = [
+            sys.executable, SERVE_HOST, "--host-id", host_id,
+            "--config", CONFIG, "--replicas", str(replicas),
+            "--seed", str(seed), "--port", "0",
+        ]
+        if peers:
+            argv += ["--peers", peers]
+        self.proc = subprocess.Popen(
+            argv, stdout=self._log, stderr=subprocess.STDOUT, cwd=REPO_ROOT,
+        )
+        self.port: Optional[int] = None
+        self.addr: Optional[str] = None
+
+    def wait_ready(self, timeout: float) -> str:
+        def ready_line():
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"{self.host_id} died (rc={self.proc.returncode}) "
+                    f"before HOST_READY (log: {self.log_path})\n"
+                    f"{self.log_tail()}"
+                )
+            try:
+                with open(self.log_path) as f:
+                    for ln in f:
+                        if ln.startswith("HOST_READY"):
+                            return ln.strip()
+            except OSError:
+                pass
+            return None
+
+        line = wait_for(ready_line, timeout, poll=0.5)
+        assert line, (
+            f"{self.host_id} not ready within {timeout}s "
+            f"(log: {self.log_path})\n{self.log_tail()}"
+        )
+        for tok in line.split():
+            if tok.startswith("port="):
+                self.port = int(tok.partition("=")[2])
+        assert self.port, f"no port on READY line: {line!r}"
+        self.addr = f"127.0.0.1:{self.port}"
+        return self.addr
+
+    def log_tail(self, n: int = 30) -> str:
+        try:
+            with open(self.log_path) as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return ""
+
+    def kill(self) -> None:
+        try:
+            if self.proc.poll() is None:
+                self.proc.kill()
+                self.proc.wait(10)
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+        self._log.close()
+
+
+def _fabric_workdir() -> str:
+    return os.environ.get("MX_RCNN_FABRIC_WD") or tempfile.mkdtemp(
+        prefix="mx_rcnn_fabric_"
+    )
+
+
+def _collect_results(accepted: list) -> tuple[list, list]:
+    results, errors = [], []
+    for r in accepted:
+        try:
+            results.append(r.result(timeout=300))
+        except Exception as e:  # noqa: BLE001 - counted, asserted by caller
+            errors.append(f"{type(e).__name__}: {e}")
+    return results, errors
+
+
+def child_host_kill_main() -> int:
+    """SIGKILL one of two REAL host processes mid-load through the
+    gateway: zero accepted-request loss, gossip flags the host dead,
+    the gateway quarantines it and rebalances onto the survivor — and
+    the survivor then honors the preemption contract (SIGTERM -> drain
+    -> exit 75)."""
+    _fleet_cpu(2)
+    import numpy as np
+    from mx_rcnn_tpu.serve import GatewayRouter, GossipNode
+    from mx_rcnn_tpu.serve.gossip import DEAD as GOSSIP_DEAD
+
+    wd = _fabric_workdir()
+    RESUMABLE_EXIT_CODE = 75  # pinned, mirrors train/preemption.py
+    hosts: list[_FabricHost] = []
+    try:
+        a = _FabricHost(wd, "hostA", replicas=2, seed=0)
+        hosts.append(a)
+        a.wait_ready(600)
+        b = _FabricHost(wd, "hostB", replicas=2, seed=0,
+                        peers=f"hostA={a.addr}")
+        hosts.append(b)
+        b.wait_ready(600)
+
+        # Observer gossip node: proves the mesh (not just the gateway's
+        # own request failures) detects the death.
+        observer = GossipNode(
+            "chaos-observer", "", lambda: {"draining": True},
+            peers={"hostA": a.addr, "hostB": b.addr},
+            period_s=0.25, suspect_after_s=1.0, dead_after_s=3.0,
+        ).start()
+        gw = GatewayRouter(
+            [a.addr, b.addr], probe_interval_s=0.25, gossip=observer,
+        ).start()
+        assert wait_for(lambda: gw.stats()["replicas"] == 2, 120), (
+            f"gateway never saw both hosts routable: {gw.stats()}"
+        )
+        img = np.random.default_rng(0).uniform(
+            0, 255, (100, 100, 3)
+        ).astype(np.float32)
+        accepted = [gw.submit(img, timeout=120) for _ in range(6)]
+        wait_for(lambda: any(r.done() for r in accepted), 300)
+        a.proc.kill()  # a whole failure domain vanishes mid-load
+        accepted += [gw.submit(img, timeout=120) for _ in range(8)]
+        results, errors = _collect_results(accepted)
+        gossip_dead = wait_for(
+            lambda: (
+                observer.peers().get("hostA") is not None
+                and observer.peers()["hostA"].status == GOSSIP_DEAD
+            ),
+            60,
+        )
+        quarantined = wait_for(lambda: gw.stats()["quarantines"] >= 1, 60)
+        post_kill_hosts = sorted(
+            {r["host_id"] for r in results[-8:]}
+        ) if len(results) >= 8 else []
+        s = gw.stats()
+        # Gateway metrics must scrape clean after the failover: the
+        # request counter and the gossip peer gauge both rendered, with
+        # traffic actually recorded (the CI fabric_smoke gate).
+        from mx_rcnn_tpu import obs
+        metrics_text = obs.render_metrics()
+        metrics_clean = (
+            "gateway_requests_total" in metrics_text
+            and "gossip_peers" in metrics_text
+            and 'outcome="ok"' in metrics_text
+        )
+        # Survivor honors the serving preemption contract.
+        b.proc.send_signal(signal.SIGTERM)
+        rc_b = b.proc.wait(240)
+        gw.stop()
+        observer.close()
+    finally:
+        for h in hosts:
+            h.kill()
+    print(json.dumps({
+        "accepted": len(accepted), "completed": len(results),
+        "errors": errors, "failed": s["failed"],
+        "retries": s["retries"], "quarantines": s["quarantines"],
+        "gossip_dead": bool(gossip_dead),
+        "post_kill_hosts": post_kill_hosts,
+        "survivor_exit": rc_b,
+        "metrics_clean": metrics_clean,
+    }))
+    assert not errors, f"accepted requests lost: {errors}"
+    assert len(results) == len(accepted)
+    assert s["failed"] == 0, s
+    assert quarantined, "gateway never quarantined the killed host"
+    assert gossip_dead, "gossip never flagged the killed host dead"
+    assert post_kill_hosts == ["hostB"], (
+        f"post-kill traffic not rebalanced onto the survivor: "
+        f"{post_kill_hosts}"
+    )
+    assert rc_b == RESUMABLE_EXIT_CODE, (
+        f"survivor drain exit {rc_b} != {RESUMABLE_EXIT_CODE}"
+    )
+    assert metrics_clean, "gateway metrics did not scrape clean"
+    return 0
+
+
+def child_host_partition_main() -> int:
+    """SIGSTOP a host (alive but silent — a partition, not a crash):
+    gossip ages it suspect -> dead, the gateway fences it, traffic
+    completes on the peer; SIGCONT heals and the probe loop reinstates."""
+    _fleet_cpu(2)
+    import numpy as np
+    from mx_rcnn_tpu.serve import GatewayRouter, GossipNode
+    from mx_rcnn_tpu.serve.gossip import ALIVE as G_ALIVE
+    from mx_rcnn_tpu.serve.gossip import DEAD as G_DEAD
+
+    wd = _fabric_workdir()
+    hosts: list[_FabricHost] = []
+    try:
+        a = _FabricHost(wd, "hostA", replicas=2, seed=0)
+        hosts.append(a)
+        a.wait_ready(600)
+        b = _FabricHost(wd, "hostB", replicas=2, seed=0,
+                        peers=f"hostA={a.addr}")
+        hosts.append(b)
+        b.wait_ready(600)
+
+        observer = GossipNode(
+            "chaos-observer", "", lambda: {"draining": True},
+            peers={"hostA": a.addr, "hostB": b.addr},
+            period_s=0.25, suspect_after_s=1.0, dead_after_s=3.0,
+        ).start()
+        gw = GatewayRouter(
+            [a.addr, b.addr], probe_interval_s=0.25, gossip=observer,
+        ).start()
+        assert wait_for(lambda: gw.stats()["replicas"] == 2, 120), (
+            f"gateway never saw both hosts routable: {gw.stats()}"
+        )
+        os.kill(a.proc.pid, signal.SIGSTOP)  # silent, not dead
+        partition_detected = wait_for(
+            lambda: (
+                observer.peers().get("hostA") is not None
+                and observer.peers()["hostA"].status == G_DEAD
+            ),
+            60,
+        )
+        fenced = wait_for(
+            lambda: gw.stats()["hosts"]
+            .get("hostA", {}).get("state") != "ready",
+            60,
+        )
+        img = np.random.default_rng(0).uniform(
+            0, 255, (100, 100, 3)
+        ).astype(np.float32)
+        accepted = [gw.submit(img, timeout=120) for _ in range(6)]
+        results, errors = _collect_results(accepted)
+        during = sorted({r["host_id"] for r in results})
+        os.kill(a.proc.pid, signal.SIGCONT)  # partition heals
+        healed = wait_for(
+            lambda: (
+                observer.peers().get("hostA") is not None
+                and observer.peers()["hostA"].status == G_ALIVE
+            ),
+            120,
+        )
+        reinstated = wait_for(
+            lambda: gw.stats()["hosts"]
+            .get("hostA", {}).get("state") == "ready",
+            120,
+        )
+        s = gw.stats()
+        gw.stop()
+        observer.close()
+    finally:
+        for h in hosts:
+            try:
+                os.kill(h.proc.pid, signal.SIGCONT)  # un-freeze first
+            except OSError:
+                pass
+            h.kill()
+    print(json.dumps({
+        "accepted": len(accepted), "completed": len(results),
+        "errors": errors, "failed": s["failed"],
+        "partition_detected": bool(partition_detected),
+        "fenced": bool(fenced), "hosts_during_partition": during,
+        "healed": bool(healed), "reinstated": bool(reinstated),
+        "quarantines": s["quarantines"],
+        "reinstatements": s["reinstatements"],
+        "routable_final": s["replicas"],
+    }))
+    assert partition_detected, "gossip never aged the stopped host to dead"
+    assert fenced, "gateway kept routing to the partitioned host"
+    assert not errors and len(results) == len(accepted), (
+        f"requests lost during the partition: {errors}"
+    )
+    assert during == ["hostB"], (
+        f"partitioned host served traffic while fenced: {during}"
+    )
+    assert healed, "gossip never saw the host come back alive"
+    assert reinstated, "probe loop never reinstated the healed host"
+    assert s["replicas"] == 2, s
+    assert s["failed"] == 0, s
+    return 0
+
+
+def child_cross_host_swap_main() -> int:
+    """Pod-wide generation-tagged weight roll across two REAL host
+    processes under load: every response from either host must
+    bitwise-match the oracle for the generation it reports."""
+    _fleet_cpu(2)
+    import numpy as np
+    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.serve import GatewayRouter
+
+    cfg = get_config(CONFIG)
+    v1 = _init_variables(cfg, seed=1)  # the roll target
+    wd = _fabric_workdir()
+    hosts: list[_FabricHost] = []
+    KEYS = ("boxes", "scores", "classes")
+
+    def sig(res):
+        return {k: np.asarray(res[k]) for k in KEYS}
+
+    def matches(res, oracle) -> bool:
+        return all(
+            np.array_equal(np.asarray(res[k]), oracle[k]) for k in KEYS
+        )
+
+    try:
+        a = _FabricHost(wd, "hostA", replicas=2, seed=0)
+        hosts.append(a)
+        a.wait_ready(600)
+        b = _FabricHost(wd, "hostB", replicas=2, seed=0,
+                        peers=f"hostA={a.addr}")
+        hosts.append(b)
+        b.wait_ready(600)
+        gw = GatewayRouter([a.addr, b.addr], probe_interval_s=0.25).start()
+        assert wait_for(lambda: gw.stats()["replicas"] == 2, 120), (
+            f"gateway never saw both hosts routable: {gw.stats()}"
+        )
+        probe = np.random.default_rng(7).uniform(
+            0, 255, (96, 128, 3)
+        ).astype(np.float32)
+        # Generation-0 oracle — computed on whichever host the gateway
+        # picks; every gen-0 response from EITHER host must match it
+        # bitwise (hosts share seed, config and compiled program).
+        oracle = {0: sig(gw.infer(probe, timeout=300))}
+        results: list[dict] = []
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def pump() -> None:
+            while not stop.is_set():
+                try:
+                    results.append(gw.infer(probe, timeout=300))
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    errors.append(f"{type(e).__name__}: {e}")
+                    return
+
+        pumps = [
+            threading.Thread(target=pump, daemon=True) for _ in range(2)
+        ]
+        for t in pumps:
+            t.start()
+        wait_for(lambda: len(results) >= 2, 300)
+        gen = gw.swap_weights(v1)  # hosts rolled ONE AT A TIME
+        wait_for(
+            lambda: any(
+                r.get("generation") == gen for r in list(results)
+            ),
+            300,
+        )
+        stop.set()
+        for t in pumps:
+            t.join(300)
+        oracle[gen] = sig(gw.infer(probe, timeout=300))
+        s = gw.stats()
+        gw.stop()
+    finally:
+        for h in hosts:
+            h.kill()
+    gens = sorted({r["generation"] for r in results})
+    hosts_used = sorted({r["host_id"] for r in results})
+    mismatched = [
+        i for i, r in enumerate(results)
+        if r["generation"] not in oracle
+        or not matches(r, oracle[r["generation"]])
+    ]
+    print(json.dumps({
+        "responses": len(results), "generations_seen": gens,
+        "hosts_used": hosts_used, "mismatched": mismatched,
+        "errors": errors, "swap_generation": gen,
+        "host_generations": {
+            h: d["generation"] for h, d in s["hosts"].items()
+        },
+    }))
+    assert not errors, f"requests failed during the roll: {errors}"
+    assert gens == [0, gen], (
+        f"expected traffic on both sides of the roll, saw {gens}"
+    )
+    assert hosts_used == ["hostA", "hostB"], (
+        f"oracle only exercised one host: {hosts_used}"
+    )
+    assert not mismatched, (
+        f"{len(mismatched)} responses matched NEITHER generation oracle "
+        "— a host served mixed or stale weights"
+    )
     return 0
 
 
@@ -1375,6 +1793,42 @@ def scenario_fleet_scale(root: str, steps: int, timeout: float) -> dict:
     return r
 
 
+# -- cross-host fabric scenarios ---------------------------------------------
+
+
+def scenario_host_kill(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "host_kill")
+    r = _json_child(root, "host_kill", "--child-host-kill", timeout,
+                    env={"MX_RCNN_FABRIC_WD": wd})
+    assert not r["errors"] and r["completed"] == r["accepted"], r
+    assert r["failed"] == 0 and r["quarantines"] >= 1, r
+    assert r["gossip_dead"] and r["post_kill_hosts"] == ["hostB"], r
+    assert r["survivor_exit"] == 75, r
+    assert r["metrics_clean"], r
+    return r
+
+
+def scenario_host_partition(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "host_partition")
+    r = _json_child(root, "host_partition", "--child-host-partition",
+                    timeout, env={"MX_RCNN_FABRIC_WD": wd})
+    assert r["partition_detected"] and r["fenced"], r
+    assert not r["errors"] and r["failed"] == 0, r
+    assert r["hosts_during_partition"] == ["hostB"], r
+    assert r["healed"] and r["reinstated"] and r["routable_final"] == 2, r
+    return r
+
+
+def scenario_cross_host_swap(root: str, steps: int, timeout: float) -> dict:
+    wd = os.path.join(root, "cross_host_swap")
+    r = _json_child(root, "cross_host_swap", "--child-cross-host-swap",
+                    timeout, env={"MX_RCNN_FABRIC_WD": wd})
+    assert not r["errors"] and not r["mismatched"], r
+    assert r["generations_seen"] == [0, r["swap_generation"]], r
+    assert r["hosts_used"] == ["hostA", "hostB"], r
+    return r
+
+
 SCENARIOS = {
     "baseline": scenario_baseline,
     "sigkill": scenario_sigkill,
@@ -1394,6 +1848,9 @@ SCENARIOS = {
     "swap_under_load": scenario_swap_under_load,
     "fleet_drain": scenario_fleet_drain,
     "fleet_scale": scenario_fleet_scale,
+    "host_kill": scenario_host_kill,
+    "host_partition": scenario_host_partition,
+    "cross_host_swap": scenario_cross_host_swap,
 }
 
 # Scenarios that restore/compare against baseline's checkpoint.
@@ -1427,6 +1884,12 @@ def main(argv=None) -> int:
         return child_fleet_drain_main()
     if argv and argv[0] == "--child-fleet-scale":
         return child_fleet_scale_main()
+    if argv and argv[0] == "--child-host-kill":
+        return child_host_kill_main()
+    if argv and argv[0] == "--child-host-partition":
+        return child_host_partition_main()
+    if argv and argv[0] == "--child-cross-host-swap":
+        return child_cross_host_swap_main()
     if argv and argv[0] == "--compare":
         return compare_main(argv[1], argv[2])
 

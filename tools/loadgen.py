@@ -32,10 +32,18 @@ BENCH_serving line always reports batch occupancy (mean + p50 over
 device calls) and ``sustained_qps_per_replica``; ``--assert-occupancy``
 gates on the mean.
 
+``--targets hostA:port,hostB:port`` swaps the local fleet for an
+in-process :class:`~mx_rcnn_tpu.serve.gateway.GatewayRouter` over REAL
+host processes (tools/serve_host.py), and ``--gateway URL`` drives a
+remote fabric endpoint over RPC — same schedule, same BENCH line, with
+``hosts`` listing every host that served traffic (``["local"]`` for the
+single-process default).
+
 Prints diagnostics to stderr and exactly one ``BENCH_serving`` JSON line
 as the LAST line on stdout:
 
-    {"bench": "serving", "replicas": 2, "qps": 6.0, "duration_s": 15.0,
+    {"bench": "serving", "replicas": 2, "hosts": ["local"],
+     "qps": 6.0, "duration_s": 15.0,
      "submitted": 90, "completed": 88, "shed": 2, "failed": 0,
      "p50_s": 0.21, "p99_s": 0.57, "max_s": 0.61,
      "killed_rid": 0, "quarantines": 1, "reinstatements": 1,
@@ -115,6 +123,92 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+class _RemoteFuture:
+    """FleetRequest-shaped handle over one remote RPC inference."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("remote request not complete")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _RemoteGateway:
+    """FleetRouter-shaped driver for a REMOTE fabric endpoint
+    (``--gateway URL``): submit/stats/stop over serve/rpc.py's client,
+    each submit running its blocking RPC on a daemon thread."""
+
+    def __init__(self, url: str) -> None:
+        from mx_rcnn_tpu.serve import RpcClient
+
+        self.client = RpcClient(url)
+
+    def submit(self, image, timeout=None, trace_id=None) -> _RemoteFuture:
+        fut = _RemoteFuture()
+
+        def run() -> None:
+            try:
+                fut._result = self.client.infer(
+                    image, deadline_s=timeout, trace_id=trace_id
+                )
+            except BaseException as e:  # noqa: BLE001 - carried to result()
+                fut._error = e
+            finally:
+                fut._event.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def stats(self) -> dict:
+        return self.client.stats()["fleet"]
+
+    def stop(self, timeout=None) -> None:
+        del timeout
+
+
+def _build_driver(args, cfg):
+    """(fleet-shaped driver, hosts list) for the three serving surfaces:
+    a local FleetRouter (default), an in-process GatewayRouter over
+    ``--targets``, or a remote fabric endpoint via ``--gateway URL``."""
+    if args.gateway:
+        drv = _RemoteGateway(args.gateway)
+        stats = drv.stats()  # fail fast when the endpoint is down
+        hosts = sorted(stats.get("hosts", {})) or [args.gateway]
+        print(f"[loadgen] driving remote gateway {args.gateway} "
+              f"(hosts: {', '.join(hosts)})", file=sys.stderr)
+        return drv, hosts
+    if args.targets:
+        from mx_rcnn_tpu.serve import GatewayRouter
+
+        targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+        gw = GatewayRouter(
+            targets, hedge_after=None, probe_interval_s=0.25,
+        ).start()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if gw.stats()["replicas"] >= len(targets):
+                break
+            time.sleep(0.1)
+        stats = gw.stats()
+        if stats["replicas"] == 0:
+            raise RuntimeError(f"no routable host among {targets}")
+        hosts = sorted(stats["hosts"])
+        print(f"[loadgen] gateway over {len(hosts)} host(s): "
+              f"{', '.join(hosts)} ({stats['replicas']} routable)",
+              file=sys.stderr)
+        return gw, hosts
+    return None, ["local"]
+
+
 def run_bench(args: argparse.Namespace) -> dict:
     import numpy as np
 
@@ -136,24 +230,27 @@ def run_bench(args: argparse.Namespace) -> dict:
               f"metrics_port={obs.metrics_port()}", file=sys.stderr)
 
     cfg = get_config(args.config)
-    variables = init_detector(
-        TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
-        cfg.data.image_size,
-    )
-    fleet = build_fleet(
-        cfg, variables, args.replicas,
-        batch_size=args.batch_size,
-        engine_kwargs={
-            "hang_timeout": 300.0, "max_queue": args.max_queue,
-            "pack": not args.no_pack, "pack_window_s": args.pack_window,
-        },
-        supervisor_poll=0.1,
-        hedge_after="auto",
-    )
-    print(f"[loadgen] starting {args.replicas} replica(s) "
-          f"(warmup compiles)...", file=sys.stderr)
-    fleet.start()
-    print("[loadgen] fleet ready", file=sys.stderr)
+    fleet, hosts = _build_driver(args, cfg)
+    if fleet is None:
+        variables = init_detector(
+            TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
+            cfg.data.image_size,
+        )
+        fleet = build_fleet(
+            cfg, variables, args.replicas,
+            batch_size=args.batch_size,
+            engine_kwargs={
+                "hang_timeout": 300.0, "max_queue": args.max_queue,
+                "pack": not args.no_pack, "pack_window_s": args.pack_window,
+            },
+            supervisor_poll=0.1,
+            hedge_after="auto",
+        )
+        print(f"[loadgen] starting {args.replicas} replica(s) "
+              f"(warmup compiles)...", file=sys.stderr)
+        fleet.start()
+        print("[loadgen] fleet ready", file=sys.stderr)
+    args._hosts = hosts
     if obs_on:
         obs.register_status("fleet", fleet.stats)
 
@@ -168,9 +265,15 @@ def run_bench(args: argparse.Namespace) -> dict:
     pending: list = []
 
     def collect(freq, t_submit: float) -> None:
-        nonlocal failed
+        nonlocal shed, failed
         try:
             freq.result(timeout=args.deadline + 60.0)
+        except Overloaded:
+            # Fabric modes surface admission-control shedding at result
+            # time (the remote 429 comes back on the response path).
+            with lock:
+                shed += 1
+            return
         except ServeError:
             with lock:
                 failed += 1
@@ -223,6 +326,10 @@ def run_bench(args: argparse.Namespace) -> dict:
                     submitted += 1
                 try:
                     freq.result(timeout=args.deadline + 60.0)
+                except Overloaded:
+                    with lock:
+                        shed += 1
+                    continue
                 except ServeError:
                     with lock:
                         failed += 1
@@ -335,9 +442,15 @@ def _finish(args, fleet, latencies, submitted, shed, failed, killed_rid,
     fleet.stop(timeout=240.0)
 
     latencies.sort()
+    host_detail = stats.get("hosts")
+    hosts = (
+        sorted(host_detail) if isinstance(host_detail, dict) and host_detail
+        else list(getattr(args, "_hosts", ["local"]))
+    )
     rec = {
         "bench": "serving",
         "replicas": args.replicas,
+        "hosts": hosts,
         "qps": args.qps,
         "profile": args.profile,
         "clients": args.clients,
@@ -426,6 +539,13 @@ def main(argv=None) -> int:
                    help="seconds the worker lingers for stragglers to "
                         "top off a partial batch")
     p.add_argument("--config", default="tiny_synthetic")
+    p.add_argument("--targets", default="",
+                   help="drive an IN-PROCESS gateway over these "
+                        "comma-separated host addrs (tools/serve_host.py "
+                        "fleets) instead of a local fleet")
+    p.add_argument("--gateway", default="",
+                   help="drive a REMOTE fabric endpoint (gateway or "
+                        "single host) at this base URL / addr")
     p.add_argument("--kill-one", action="store_true",
                    help="kill replica 0 at the midpoint of the window")
     p.add_argument("--assert-p99", type=float, default=None,
@@ -442,6 +562,11 @@ def main(argv=None) -> int:
                    help="with --obs-dir: bind /metrics here (0 = "
                         "ephemeral, shown on stderr)")
     args = p.parse_args(argv)
+    if args.targets and args.gateway:
+        p.error("--targets and --gateway are mutually exclusive")
+    if args.kill_one and (args.targets or args.gateway):
+        p.error("--kill-one drives a LOCAL fleet; use tools/chaos.py "
+                "host_kill for fabric-level failure injection")
     _hermetic_cpu(args.replicas)
 
     rec = run_bench(args)
