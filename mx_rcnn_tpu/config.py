@@ -537,6 +537,20 @@ class ServeConfig:
     # packable immediately — lowest latency, occupancy rides on queue
     # depth.
     pack_window_s: float = 0.0
+    # Serving-side fused-middle override (detection/graph.py): "inherit"
+    # keeps model.rpn.fused_middle / model.rpn.nms_impl as-is; "on"
+    # forces fused_middle=True + nms_impl="pallas" for every serving
+    # program (full/small/reduced/proposals and the q8 levels); "off"
+    # forces the dense XLA chain.  Same off-TPU fallback and
+    # MX_RCNN_PALLAS_INTERPRET contract as training — off-TPU without
+    # interpret mode the override silently serves the dense chain.
+    fused_middle: str = "inherit"
+    # Content-addressed result cache (serve/result_cache.py): max cached
+    # responses per router (LRU).  0 (default) disables the cache AND
+    # in-flight coalescing — duplicate-heavy serving surfaces opt in
+    # (tools/loadgen.py defaults its fleets to 256); chaos/fault drills
+    # keep it off so every request exercises a real replica.
+    result_cache_capacity: int = 0
 
 
 @dataclass(frozen=True)

@@ -47,6 +47,7 @@ from mx_rcnn_tpu.serve.gossip import (
     merge_table,
 )
 from mx_rcnn_tpu.serve.health import EngineHealth
+from mx_rcnn_tpu.serve.result_cache import ResultCache, content_key
 from mx_rcnn_tpu.serve.rpc import HostRpcServer, HostUnreachable, RpcClient
 from mx_rcnn_tpu.serve.router import (
     DEAD,
@@ -91,6 +92,8 @@ __all__ = [
     "HostUnreachable",
     "RpcClient",
     "EngineHealth",
+    "ResultCache",
+    "content_key",
     "DEAD",
     "DEGRADED",
     "QUARANTINED",

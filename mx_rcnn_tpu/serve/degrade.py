@@ -27,10 +27,11 @@ from typing import Callable, Mapping, Optional, Sequence
 from mx_rcnn_tpu import obs
 
 # Quality-ordered serving levels, best first.  ``small`` reuses the FULL
-# program of a smaller resolution bucket; ``full_q8``, ``reduced`` and
+# program of a smaller resolution bucket; ``full_q8`` (int8 box head),
+# ``full_q8n`` (int8 whole network — cheaper, noisier), ``reduced`` and
 # ``proposals`` are distinct compiled programs (engine warmup compiles
 # them up front so degrading never pays a compile mid-incident).
-LEVELS = ("full", "small", "full_q8", "reduced", "proposals")
+LEVELS = ("full", "small", "full_q8", "full_q8n", "reduced", "proposals")
 
 # Levels that run the full-quality pipeline; the circuit breaker guards
 # these (a failing/overrunning full path should stop being probed at

@@ -173,11 +173,25 @@ class DetectorRunner:
 
     Programs (all compiled at warmup, none ever added after):
       * ``("full", bucket)`` for EVERY bucket — the production detector.
-      * ``("full_q8", smallest bucket)`` — int8/bf16 box head
+      * ``("full_q8", bucket)`` for EVERY bucket — int8/bf16 box head
         (serve/quantize.py), when built with ``int8_head=True``.
+        Quantization degrades precision, not resolution, so q8 requests
+        keep their own shape bucket instead of being letterboxed down.
+      * ``("full_q8n", bucket)`` for EVERY bucket — full-network
+        weight-only int8 (backbone/FPN/RPN/head), when built with
+        ``int8_network=True``.  Same per-bucket reasoning.
       * ``("reduced", smallest bucket)`` — ``reduced_max_detections``
         output slots (cheaper postprocess/NMS).
       * ``("proposals", smallest bucket)`` — RPN-only, class-agnostic.
+
+    ``cfg.serve.fused_middle`` overrides the detection middle for every
+    serving program: ``"on"`` forces the fused Pallas proposal chain
+    (``rpn.fused_middle=True, nms_impl="pallas"``), ``"off"`` forces the
+    dense XLA chain, ``"inherit"`` keeps ``cfg.model.rpn`` as-is.  The
+    override rides the model config the programs are traced from, so it
+    inherits training's off-TPU fallback and
+    ``MX_RCNN_PALLAS_INTERPRET`` contract unchanged
+    (detection/graph.py::_propose_one).
 
     ``run`` letterboxes each request image into the plan's bucket, pads
     the micro-batch to the static ``batch_size``, executes, and maps
@@ -207,6 +221,7 @@ class DetectorRunner:
         reduced_max_detections: Optional[int] = None,
         with_proposals: bool = True,
         int8_head: bool = False,
+        int8_network: bool = False,
         device: Optional[object] = None,
     ) -> None:
         import dataclasses
@@ -228,11 +243,33 @@ class DetectorRunner:
         self.reduced_max_detections = int(reduced_max_detections)
         stats = (cfg.data.pixel_mean, cfg.data.pixel_std)
 
-        model = TwoStageDetector(cfg=cfg.model)
+        # Serving-side fused-middle override: trace every program from a
+        # model config whose rpn section reflects cfg.serve.fused_middle.
+        # graph._propose_one reads these at trace time, so the existing
+        # off-TPU fallback / MX_RCNN_PALLAS_INTERPRET contract applies.
+        model_cfg = cfg.model
+        fused = getattr(getattr(cfg, "serve", None), "fused_middle",
+                        "inherit")
+        if fused not in ("inherit", "on", "off"):
+            raise ValueError(
+                f"serve.fused_middle must be inherit/on/off, got {fused!r}"
+            )
+        if fused != "inherit":
+            model_cfg = dataclasses.replace(
+                model_cfg,
+                rpn=dataclasses.replace(
+                    model_cfg.rpn,
+                    fused_middle=(fused == "on"),
+                    nms_impl="pallas" if fused == "on" else "xla",
+                ),
+            )
+        self.model_cfg = model_cfg
+
+        model = TwoStageDetector(cfg=model_cfg)
         reduced_cfg = dataclasses.replace(
-            cfg.model,
+            model_cfg,
             test=dataclasses.replace(
-                cfg.model.test,
+                model_cfg.test,
                 max_detections=self.reduced_max_detections,
                 fused_top_k=min(
                     cfg.model.test.fused_top_k,
@@ -291,13 +328,30 @@ class DetectorRunner:
                     ),
                 )
             )
-            # Like the other degrade programs, compiled for the smallest
-            # bucket only (engine._plan routes non-full levels there).
-            self._program_keys.append(("full_q8", self.buckets[0]))
-        # Live weight buffers: (params, quantized head | None, generation).
-        # One tuple so the swap flip is a single reference assignment.
+            # Per-bucket like "full": quantization trades precision, not
+            # resolution, so a q8 request must not be silently
+            # letterboxed into the smallest shape.
+            self._program_keys += [("full_q8", b) for b in self.buckets]
+        self._int8_network = bool(int8_network)
+        if int8_network:
+            from mx_rcnn_tpu.serve.quantize import dequantize_network
+
+            # The whole variables tree is replaced by its int8/scale
+            # form and reconstructed IN-GRAPH — the program body is the
+            # production forward_inference, unchanged; only the weight
+            # operand shrinks 4x.
+            self._q8n_step = plan.compile_infer(
+                lambda qn, b: forward_inference(
+                    model, dequantize_network(qn), b, pixel_stats=stats
+                )
+            )
+            self._program_keys += [("full_q8n", b) for b in self.buckets]
+        # Live weight buffers: (params, quantized head | None, quantized
+        # network | None, generation).  One tuple so the swap flip is a
+        # single reference assignment.
         self._active = (
-            plan.place(variables), self._quantized(variables), 0
+            plan.place(variables), self._quantized(variables),
+            self._quantized_net(variables), 0,
         )
         if with_proposals:
             self._program_keys += [
@@ -318,10 +372,18 @@ class DetectorRunner:
 
         return self._plan.place(quantize_box_head(variables))
 
+    def _quantized_net(self, variables):
+        """Quantize + place the whole network for q8n (or None)."""
+        if not self._int8_network:
+            return None
+        from mx_rcnn_tpu.serve.quantize import quantize_network
+
+        return self._plan.place(quantize_network(variables))
+
     @property
     def generation(self) -> int:
         """Monotonic weight-swap counter; 0 = the construction weights."""
-        return self._active[2]
+        return self._active[3]
 
     def swap_weights(self, variables, generation: Optional[int] = None) -> int:
         """Zero-downtime weight swap: warm the standby buffer, then flip.
@@ -338,7 +400,7 @@ class DetectorRunner:
         """
         import jax
 
-        live_vars, _, live_gen = self._active
+        live_vars, _, _, live_gen = self._active
         flat_new = jax.tree_util.tree_flatten(variables)
         flat_live = jax.tree_util.tree_flatten(live_vars)
         if flat_new[1] != flat_live[1]:
@@ -361,11 +423,12 @@ class DetectorRunner:
                 )
         new_vars = self._plan.place(variables)
         new_q8 = self._quantized(variables)
+        new_q8n = self._quantized_net(variables)
         # Warm the standby buffer: the transfer completes (device-resident
         # HBM) before the flip, so the first post-flip request pays zero
         # copy latency.
         jax.block_until_ready(
-            new_vars if new_q8 is None else (new_vars, new_q8)
+            tuple(t for t in (new_vars, new_q8, new_q8n) if t is not None)
         )
         gen = live_gen + 1 if generation is None else int(generation)
         if gen <= live_gen:
@@ -373,7 +436,7 @@ class DetectorRunner:
                 f"swap_weights: generation must be monotonic "
                 f"({live_gen} -> {gen})"
             )
-        self._active = (new_vars, new_q8, gen)
+        self._active = (new_vars, new_q8, new_q8n, gen)
         return gen
 
     # -- engine-facing surface --------------------------------------------
@@ -384,6 +447,8 @@ class DetectorRunner:
             out.append("small")
         if any(m == "full_q8" for m, _ in self._program_keys):
             out.append("full_q8")
+        if any(m == "full_q8n" for m, _ in self._program_keys):
+            out.append("full_q8n")
         out.append("reduced")
         if any(m == "proposals" for m, _ in self._program_keys):
             out.append("proposals")
@@ -407,7 +472,7 @@ class DetectorRunner:
         """Compile every program with a zero batch; returns program count."""
         import jax
 
-        variables, box_q8, _ = self._active
+        variables, box_q8, net_q8, _ = self._active
         for mode, bucket in self._program_keys:
             batch = self._make_batch(
                 np.zeros((self.batch_size, *bucket, 3), np.float32),
@@ -417,6 +482,8 @@ class DetectorRunner:
             )
             if mode == "full_q8":
                 out = self._q8_step(variables, box_q8, batch)
+            elif mode == "full_q8n":
+                out = self._q8n_step(net_q8, batch)
             else:
                 out = self._steps[mode](variables, batch)
             jax.block_until_ready(out)
@@ -440,9 +507,9 @@ class DetectorRunner:
         from mx_rcnn_tpu.data.transforms import letterbox, normalize_image
 
         # One read of the live buffers: the whole micro-batch executes
-        # against a consistent (params, q8, generation) snapshot even if
-        # swap_weights flips mid-call.
-        variables, box_q8, generation = self._active
+        # against a consistent (params, q8, q8n, generation) snapshot
+        # even if swap_weights flips mid-call.
+        variables, box_q8, net_q8, generation = self._active
         rows, hw, scales, orig = [], [], [], []
         for img in images:
             h, w = img.shape[:2]
@@ -470,6 +537,8 @@ class DetectorRunner:
         )
         if mode == "full_q8":
             out = jax.device_get(self._q8_step(variables, box_q8, batch))
+        elif mode == "full_q8n":
+            out = jax.device_get(self._q8n_step(net_q8, batch))
         else:
             out = jax.device_get(self._steps[mode](variables, batch))
         results = [
@@ -796,6 +865,10 @@ class InferenceEngine:
         if level == "small":
             assert smaller is not None
             return Plan("small", "full", smaller)
+        if level in ("full_q8", "full_q8n"):
+            # q8 programs compile per-bucket like "full" — quantization
+            # degrades precision, not resolution.
+            return Plan(level, level, base)
         # reduced / proposals programs exist for the smallest bucket only.
         return Plan(level, level, self.runner.buckets[0])
 
@@ -1105,6 +1178,7 @@ def build_engine(
     buckets: Optional[Sequence[tuple[int, int]]] = None,
     batch_size: Optional[int] = None,
     int8_head: bool = False,
+    int8_network: bool = False,
     device: Optional[object] = None,
     **engine_kwargs,
 ) -> InferenceEngine:
@@ -1119,6 +1193,6 @@ def build_engine(
         engine_kwargs.setdefault("pack_window_s", serve_cfg.pack_window_s)
     runner = DetectorRunner(
         cfg, variables, buckets=buckets, batch_size=batch_size,
-        int8_head=int8_head, device=device,
+        int8_head=int8_head, int8_network=int8_network, device=device,
     )
     return InferenceEngine(runner, **engine_kwargs)

@@ -48,6 +48,7 @@ import time
 from typing import Callable, Optional, Sequence, Union
 
 from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.serve import result_cache as result_cache_mod
 from mx_rcnn_tpu.serve.engine import (
     DeadlineExceeded,
     EngineUnavailable,
@@ -95,6 +96,18 @@ class FleetRequest:
         # span (and the engine spans under it) shares trace_id.
         self.trace_id: Optional[str] = None
         self.span = None
+        # Result-cache coordinates ((content_key, generation)) when this
+        # request is a cache LEADER; its done-hooks settle the cache and
+        # release coalesced followers on either latch path.
+        self._cache_key: Optional[tuple[str, int]] = None
+        self._done_hooks: list = []
+
+    def _run_done_hooks(self) -> None:
+        for hook in list(self._done_hooks):
+            try:
+                hook(self)
+            except Exception:  # noqa: BLE001 - hooks must not break the latch
+                log.exception("fleet request done-hook failed")
 
     def _latch_result(self, result: dict) -> bool:
         with self._lock:
@@ -104,6 +117,7 @@ class FleetRequest:
             self._event.set()
         if self.span is not None:
             self.span.end(outcome="ok")
+        self._run_done_hooks()
         return True
 
     def _latch_error(self, error: BaseException) -> bool:
@@ -114,6 +128,7 @@ class FleetRequest:
             self._event.set()
         if self.span is not None:
             self.span.end(error=type(error).__name__)
+        self._run_done_hooks()
         return True
 
     def tried_rids(self) -> frozenset[int]:
@@ -190,6 +205,7 @@ class FleetRouter:
         max_rebuilds: int = 3,
         supervisor_poll: float = 0.25,
         default_timeout: Optional[float] = None,
+        result_cache=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if n_replicas < 1:
@@ -197,6 +213,9 @@ class FleetRouter:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self._engine_factory = engine_factory
+        # Content-addressed response cache + coalescing registry
+        # (serve/result_cache.py); None disables both.
+        self._cache = result_cache
         self.n_replicas = n_replicas
         self.hedge_after = hedge_after
         self.max_attempts = max_attempts
@@ -336,6 +355,37 @@ class FleetRouter:
             )
             freq.trace_id = freq.span.trace_id
         freq.bucket = self._bucket_for(image)
+        # Result cache: consulted before ANY replica is chosen.  A hit
+        # completes the request without a device call; a miss with an
+        # identical request already in flight coalesces onto it (one
+        # device call serves everyone, like hedge first-wins dedup);
+        # otherwise this request leads and settles the cache on latch.
+        if self._cache is not None:
+            ckey = result_cache_mod.content_key(image)
+            if ckey is not None:
+                with self._lock:
+                    gen = self._generation
+                hit = self._cache.lookup(ckey, gen)
+                if hit is not None:
+                    with self._lock:
+                        self._submitted += 1
+                        self._completed += 1
+                    self._count_outcome("completed")
+                    freq._latch_result(hit)
+                    return freq
+                if self._cache.coalesce(ckey, gen, freq):
+                    # Follower: no placement, no watcher — it latches
+                    # when the leader settles (result or error).
+                    with self._lock:
+                        self._submitted += 1
+                        self._pending += 1
+                    return freq
+                # Leader only: the settle hook pops the in-flight entry
+                # and releases followers; a follower must never carry it
+                # (its latch would re-settle and re-insert its stamped
+                # copy of the response).
+                freq._cache_key = (ckey, gen)
+                freq._done_hooks.append(self._settle_cached)
         try:
             self._place(freq, is_hedge=False)
         except Overloaded:
@@ -345,6 +395,7 @@ class FleetRouter:
             self._count_outcome("shed")
             if freq.span is not None:
                 freq.span.end(error="Overloaded")
+            self._abort_cached(freq, Overloaded("leader shed"))
             raise
         except ServeError as e:
             with self._lock:
@@ -353,6 +404,7 @@ class FleetRouter:
             self._count_outcome("failed")
             if freq.span is not None:
                 freq.span.end(error=type(e).__name__)
+            self._abort_cached(freq, e)
             raise
         with self._lock:
             self._submitted += 1
@@ -365,6 +417,50 @@ class FleetRouter:
 
     def infer(self, image, timeout: Optional[float] = None) -> dict:
         return self.submit(image, timeout).result()
+
+    # -- result cache -------------------------------------------------------
+
+    def _settle_cached(self, freq: FleetRequest) -> None:
+        """Leader latched (result OR error): publish to the cache and
+        latch every coalesced follower with the same outcome.  Runs as a
+        request done-hook, so both latch paths (the sub done-callback
+        and the watcher's deadline/no-replica errors) settle exactly
+        once — ``ResultCache.settle`` pops the in-flight entry."""
+        if self._cache is None or freq._cache_key is None:
+            return
+        ckey, gen = freq._cache_key
+        err = freq._error
+        res = freq._result if err is None else None
+        followers = self._cache.settle(ckey, gen, res)
+        for f in followers:
+            if err is None:
+                assert res is not None
+                if f._latch_result(self._cache.follower_view(res)):
+                    with self._lock:
+                        self._completed += 1
+                        self._pending -= 1
+                    self._count_outcome("completed")
+            else:
+                if f._latch_error(err):
+                    with self._lock:
+                        self._failed += 1
+                        self._pending -= 1
+                    self._count_outcome("failed")
+
+    def _abort_cached(self, freq: FleetRequest,
+                      err: BaseException) -> None:
+        """A cache leader that failed AT PLACEMENT (shed / unroutable)
+        never latches, so its done-hook never fires — release any
+        follower that joined in the placement window here."""
+        if self._cache is None or freq._cache_key is None:
+            return
+        ckey, gen = freq._cache_key
+        for f in self._cache.settle(ckey, gen, None):
+            if f._latch_error(err):
+                with self._lock:
+                    self._failed += 1
+                    self._pending -= 1
+                self._count_outcome("failed")
 
     def swap_weights(self, variables,
                      generation: Optional[int] = None) -> int:
@@ -396,6 +492,11 @@ class FleetRouter:
                     r for r in self._replicas.values()
                     if r.state in ROUTABLE
                 ]
+            if self._cache is not None:
+                # New generation: older cached responses can no longer
+                # be looked up (the key carries the generation); drop
+                # them now rather than waiting for LRU pressure.
+                self._cache.invalidate_below(target)
             for r in live:
                 try:
                     r.engine.swap_weights(variables, generation=target)
@@ -463,6 +564,8 @@ class FleetRouter:
             }
             for rid, state, inflight, streak, rebuilds, eng in reps
         ]
+        if self._cache is not None:
+            out["cache"] = self._cache.stats()
         return out
 
     # -- placement ---------------------------------------------------------
@@ -952,14 +1055,15 @@ def build_fleet(
     buckets: Optional[Sequence[tuple[int, int]]] = None,
     batch_size: Optional[int] = None,
     int8_head: bool = False,
+    int8_network: bool = False,
     engine_kwargs: Optional[dict] = None,
     **fleet_kwargs,
 ) -> FleetRouter:
     """Real JAX wiring: replica ``rid`` pins to ``jax.devices()[rid]``
     (modulo the device count) through the execution plan, so an
     N-replica fleet on an N-chip host serves one replica per chip.
-    ``cfg.serve`` supplies micro-batch/packing defaults; explicit
-    arguments and ``engine_kwargs`` win."""
+    ``cfg.serve`` supplies micro-batch/packing/result-cache defaults;
+    explicit arguments and ``engine_kwargs`` win."""
     import jax
 
     from mx_rcnn_tpu.serve.engine import DetectorRunner
@@ -972,11 +1076,18 @@ def build_fleet(
     if serve_cfg is not None:
         ekw.setdefault("pack", serve_cfg.pack)
         ekw.setdefault("pack_window_s", serve_cfg.pack_window_s)
+    if "result_cache" not in fleet_kwargs:
+        cap = getattr(serve_cfg, "result_cache_capacity", 0) \
+            if serve_cfg is not None else 0
+        if cap > 0:
+            fleet_kwargs["result_cache"] = \
+                result_cache_mod.ResultCache(capacity=cap)
 
     def factory(rid: int) -> InferenceEngine:
         runner = DetectorRunner(
             cfg, variables,
             buckets=buckets, batch_size=batch_size, int8_head=int8_head,
+            int8_network=int8_network,
             device=devices[rid % len(devices)],
         )
         return InferenceEngine(runner, replica_id=rid, **ekw)

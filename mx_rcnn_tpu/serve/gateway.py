@@ -45,6 +45,7 @@ from .engine import (
     Overloaded,
     ServeError,
 )
+from . import result_cache as result_cache_mod
 from .router import DEAD, QUARANTINED, READY
 from .rpc import HostUnreachable, RpcClient, encode_tree_leaves
 
@@ -91,7 +92,8 @@ class GatewayRequest:
 
     __slots__ = ("image", "submitted_at", "deadline", "trace_id", "span",
                  "_lock", "_event", "_result", "_error", "_tried",
-                 "_attempts_started", "_hedged", "_retries", "_on_done")
+                 "_attempts_started", "_hedged", "_retries", "_on_done",
+                 "_cache_key", "_cache_settle")
 
     def __init__(self, image, submitted_at: float,
                  deadline: Optional[float]) -> None:
@@ -109,6 +111,11 @@ class GatewayRequest:
         self._hedged = False
         self._retries = 0
         self._on_done: Optional[Callable[[], None]] = None
+        # Result-cache coordinates + settle hook when this request leads
+        # a coalesced group (serve/result_cache.py).
+        self._cache_key: Optional[tuple] = None
+        self._cache_settle: Optional[Callable[["GatewayRequest"], None]] \
+            = None
 
     def _latch_result(self, result: dict) -> bool:
         with self._lock:
@@ -139,6 +146,13 @@ class GatewayRequest:
             try:
                 cb()
             except Exception:  # noqa: BLE001
+                pass
+        settle = self._cache_settle
+        self._cache_settle = None
+        if settle is not None:
+            try:
+                settle(self)
+            except Exception:  # noqa: BLE001 - must not break the latch
                 pass
 
     def tried_hosts(self) -> frozenset[str]:
@@ -213,6 +227,7 @@ class GatewayRouter:
         probe_interval_s: float = 0.5,
         default_timeout: Optional[float] = None,
         gossip=None,
+        result_cache=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if isinstance(targets, Mapping):
@@ -229,6 +244,10 @@ class GatewayRouter:
         self.probe_interval_s = float(probe_interval_s)
         self.default_timeout = default_timeout
         self.gossip = gossip
+        # Pod-level content-addressed response cache + coalescing
+        # (serve/result_cache.py); None disables both.  Keyed on the POD
+        # generation, so a pod-wide weight roll invalidates everywhere.
+        self._cache = result_cache
         self._clock = clock
         self._lock = threading.Lock()
         self._swap_lock = threading.Lock()
@@ -324,6 +343,33 @@ class GatewayRouter:
                 "request", subsystem="gateway", trace_id=trace_id
             )
             req.trace_id = req.span.trace_id
+        # Result cache: consulted before ANY host is chosen — a pod-level
+        # duplicate never crosses the wire, let alone touches a device.
+        # Misses with an identical request in flight coalesce onto its
+        # leader (one RPC, one device call, everyone latches the result).
+        if self._cache is not None:
+            ckey = result_cache_mod.content_key(image)
+            if ckey is not None:
+                with self._lock:
+                    gen = self._generation
+                hit = self._cache.lookup(ckey, gen)
+                if hit is not None:
+                    with self._lock:
+                        self._submitted += 1
+                        self._completed += 1
+                    self._m_requests.inc(host="-", outcome="cache_hit")
+                    req._latch_result(hit)
+                    return req
+                req._cache_key = (ckey, gen)
+                if self._cache.coalesce(ckey, gen, req):
+                    with self._lock:
+                        self._submitted += 1
+                        self._pending += 1
+                    req._on_done = self._request_done
+                    self._m_requests.inc(host="-", outcome="coalesced")
+                    return req
+                # Leader: settles the cache (and its followers) on latch.
+                req._cache_settle = self._settle_cached
         view = select_host(self.views(), exclude=frozenset())
         if view is None:
             with self._lock:
@@ -332,6 +378,9 @@ class GatewayRouter:
             self._m_requests.inc(host="-", outcome="unroutable")
             if req.span is not None:
                 req.span.end(error="EngineUnavailable")
+            self._abort_cached(req, EngineUnavailable(
+                "no routable host in the pod"
+            ))
             raise EngineUnavailable("no routable host in the pod")
         with self._lock:
             self._submitted += 1
@@ -361,6 +410,42 @@ class GatewayRouter:
     def _request_done(self) -> None:
         with self._lock:
             self._pending -= 1
+
+    # -- result cache -------------------------------------------------------
+
+    def _settle_cached(self, req: GatewayRequest) -> None:
+        """Cache leader latched (result OR error): publish the response
+        and latch every coalesced follower with the same outcome.
+        Failures are never cached — the next identical request leads a
+        fresh attempt."""
+        if self._cache is None or req._cache_key is None:
+            return
+        ckey, gen = req._cache_key
+        err = req._error
+        res = req._result if err is None else None
+        followers = self._cache.settle(ckey, gen, res)
+        for f in followers:
+            if err is None:
+                assert res is not None
+                if f._latch_result(self._cache.follower_view(res)):
+                    with self._lock:
+                        self._completed += 1
+            else:
+                if f._latch_error(err):
+                    with self._lock:
+                        self._failed += 1
+
+    def _abort_cached(self, req: GatewayRequest,
+                      err: BaseException) -> None:
+        """A cache leader that failed before launch never latches, so
+        its settle hook never fires — release any follower here."""
+        if self._cache is None or req._cache_key is None:
+            return
+        ckey, gen = req._cache_key
+        for f in self._cache.settle(ckey, gen, None):
+            if f._latch_error(err):
+                with self._lock:
+                    self._failed += 1
 
     def _deadline_backstop(self, req: GatewayRequest) -> None:
         if not req.done():
@@ -628,7 +713,7 @@ class GatewayRouter:
             routable = sum(
                 1 for h in self._hosts.values() if h.state == READY
             )
-            return {
+            out = {
                 "hosts": hosts,
                 "replicas": routable,   # routable failure domains
                 "generation": self._generation,
@@ -644,6 +729,9 @@ class GatewayRouter:
                 "quarantines": self._quarantines,
                 "reinstatements": self._reinstatements,
             }
+        if self._cache is not None:
+            out["cache"] = self._cache.stats()
+        return out
 
     # -- weight roll -------------------------------------------------------
 
@@ -668,6 +756,11 @@ class GatewayRouter:
                 target = self._generation + 1
                 self._generation = target
                 self._last_leaves = leaves
+            if self._cache is not None:
+                # Generation-keyed lookups can't see the old entries;
+                # dropping them now is memory hygiene.
+                self._cache.invalidate_below(target)
+            with self._lock:
                 live = [
                     h for h in self._hosts.values() if h.state == READY
                 ]

@@ -1,36 +1,57 @@
-"""int8/bf16 serving program for the RCNN box head.
+"""int8 weight-only PTQ for serving: box-head program + full network.
 
-Weight-only symmetric per-output-channel int8 over the four BoxHead
-Dense kernels (fc6 / fc7 / cls_score / bbox_pred); biases stay f32.  At
-serving time the int8 weights dequantize to bf16 in-graph (one f32
-multiply per weight, fused by XLA into the parameter load — the same
-shape of trick as the frozen-BN fold) and the dots run bf16 x bf16 with
-f32 accumulation via ``preferred_element_type`` — the MXU's native
-mode.  Logits/deltas are emitted f32, the BoxHead output contract, so
-postprocess (softmax, decode, NMS) is byte-for-byte the production
-graph.
+Two quantization surfaces, same numerics (symmetric per-output-channel
+int8 with f32 scales, ``utils/precision.py``):
 
-Why weight-only and why only the box head: this is the one place
-serving wins from int8 with NO calibration data.  The head's Dense
+* **Box head** (``quantize_box_head`` / ``apply_box_head_q8``) — the
+  original ``full_q8`` degrade level.  Weight-only int8 over the four
+  BoxHead Dense kernels (fc6 / fc7 / cls_score / bbox_pred); biases
+  stay f32.  At serving time the int8 weights dequantize to bf16
+  in-graph (one f32 multiply per weight, fused by XLA into the
+  parameter load — the same shape of trick as the frozen-BN fold) and
+  the dots run bf16 x bf16 with f32 accumulation via
+  ``preferred_element_type`` — the MXU's native mode.  Logits/deltas
+  are emitted f32, the BoxHead output contract, so postprocess
+  (softmax, decode, NMS) is byte-for-byte the production graph.
+
+* **Full network** (``quantize_network`` / ``dequantize_network``) —
+  the ``full_q8n`` degrade level.  Every ``params`` kernel with an
+  output-channel axis (backbone convs, FPN laterals/top-down, the RPN
+  head, the box head) is replaced by an int8/scale pair; biases and the
+  frozen-BN ``constants`` collection pass through f32.
+  ``dequantize_network`` runs INSIDE the jitted serving program: the
+  scale multiply happens in f32 (exact: ``q`` is integral, ``scale`` a
+  power-free f32, so ``q*scale`` round-trips the rounded weight
+  bit-for-bit) and the reconstructed master rides the model's existing
+  flax param→compute cast — dequant→bf16 compute with
+  ``preferred_element_type=f32`` accumulation, no second cast path.
+  Under the all-f32 tiny_synthetic policy the only error is the int8
+  rounding itself, so CPU tests can pin per-layer budgets exactly
+  (|w - deq| ≤ scale/2 per channel).
+
+Why weight-only: it needs NO calibration data.  The head's Dense
 kernels dominate its bytes (fc6 alone is ``S*S*C x 1024``; the VGG
-recipe's fc6/fc7 are ~0.5 GB of f32 — 4x smaller as int8), while its
-activations are a few thousand pooled rows — activation quantization
-would buy little and cost a calibration sweep.  The backbone stays
-bf16: convs are compute-bound on the MXU, so int8 weights there save
-HBM traffic the backbone doesn't bottleneck on.
+recipe's fc6/fc7 are ~0.5 GB of f32 — 4x smaller as int8); the
+full-network tree cuts weight HBM traffic ~4x across the backbone/FPN/
+RPN too, which is where the serving FLOPs live (ROADMAP item 1).
+Activations stay in the policy dtype — activation quantization would
+cost a calibration sweep for little serving win.
 
 Numerics: symmetric int8 with per-output-channel scales keeps the
 worst-case relative weight error ~= 1/254 per channel; the acceptance
-tolerance (tests/test_precision.py) is on final scores/boxes, not
-weights, because the softmax/NMS pipeline absorbs sub-percent logit
-noise for all but threshold-straddling detections.
+tolerance (tests/test_precision.py) is per-layer error budgets plus an
+mAP-parity gate on final detections, because the softmax/NMS pipeline
+absorbs sub-percent logit noise for all but threshold-straddling
+detections.
 
-Wiring: :func:`quantize_box_head` runs once at runner construction (the
-quantized tree is device_put and PASSED AS AN ARGUMENT to the jitted
-step — closed-over arrays would embed as HLO constants and blow the
+Wiring: the quantizers run once at runner construction (the quantized
+trees are device_put and PASSED AS ARGUMENTS to the jitted steps —
+closed-over arrays would embed as HLO constants and blow the
 remote-compile request limit, see serve/engine.py's eval note);
 :func:`apply_box_head_q8` is injected into
-``detection/graph.py::forward_inference`` through ``box_head_apply``.
+``detection/graph.py::forward_inference`` through ``box_head_apply``,
+while :func:`dequantize_network` reconstructs the whole variables tree
+in-graph so ``forward_inference`` itself is untouched.
 """
 
 from __future__ import annotations
@@ -97,4 +118,63 @@ def apply_box_head_q8(
     return (
         logits.astype(jnp.float32),
         deltas.reshape(r, -1, 4).astype(jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# full-network PTQ (the ``full_q8n`` degrade level)
+# ---------------------------------------------------------------------------
+
+
+def _path_keys(path) -> list:
+    """Dict/attr key names along a tree_util key path (version-robust)."""
+    out = []
+    for k in path:
+        key = getattr(k, "key", None)
+        if key is None:
+            key = getattr(k, "name", None)
+        out.append(key)
+    return out
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    """True for the ``{"q": int8, "scale": f32}`` marker dicts that
+    :func:`quantize_network` substitutes for quantizable kernels."""
+    return isinstance(x, dict) and set(x.keys()) == {"q", "scale"}
+
+
+def quantize_network(variables) -> dict:
+    """Whole-tree weight-only PTQ: every ``params`` leaf named
+    ``kernel`` with ndim >= 2 (conv and dense kernels all share that
+    name and layout — output channel last) becomes ``{"q": int8,
+    "scale": f32}``; every other leaf (biases, frozen-BN ``constants``)
+    passes through unchanged.  The result is a plain pytree with the
+    same dict skeleton as ``variables``, safe to ``device_put`` and
+    pass through jit boundaries."""
+    from jax.tree_util import tree_map_with_path
+
+    def one(path, leaf):
+        keys = _path_keys(path)
+        leaf = jnp.asarray(leaf)
+        if keys and keys[0] == "params" and keys[-1] == "kernel" \
+                and leaf.ndim >= 2:
+            q, scale = quantize_per_channel(leaf, axis=-1)
+            return {"q": q, "scale": scale}
+        return leaf
+
+    return tree_map_with_path(one, variables)
+
+
+def dequantize_network(qnet, dtype: Any = jnp.float32):
+    """In-graph inverse of :func:`quantize_network`: rebuild a full
+    variables tree the model can apply.  Dequantization to f32 is exact
+    modulo the original int8 rounding (integral ``q`` times its channel
+    scale), and the reconstructed masters then ride the model's normal
+    flax param→compute-dtype cast — so the q8n program IS the production
+    graph with rounded weights, nothing else moves."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x["q"], x["scale"], dtype)
+        if is_quantized_leaf(x) else x,
+        qnet,
+        is_leaf=is_quantized_leaf,
     )

@@ -130,7 +130,8 @@ def auto_hedge_delay(
     (full-quality) estimate, so hedges fire for *stragglers*, not for
     the ordinary tail.  None until an estimate exists — hedging on zero
     information would double every request during warmup."""
-    for lvl in ("full", "small", "full_q8", "reduced", "proposals"):
+    for lvl in ("full", "small", "full_q8", "full_q8n", "reduced",
+                "proposals"):
         est = estimates.get(lvl)
         if est is not None:
             return max(floor, est * multiplier)

@@ -308,6 +308,340 @@ class TestInt8BoxHead:
 
 
 # ---------------------------------------------------------------------------
+# full-network int8 PTQ (r16 tentpole) + result cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_detector():
+    from mx_rcnn_tpu.detection import TwoStageDetector
+    from mx_rcnn_tpu.detection.graph import init_detector
+
+    cfg = get_config("tiny_synthetic")
+    model = TwoStageDetector(cfg=cfg.model)
+    h, w = cfg.data.image_size
+    variables = init_detector(model, jax.random.PRNGKey(0), (h, w))
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def q8n_runner(tiny_detector):
+    """Warmed runner with BOTH int8 surfaces: the box head (full_q8) and
+    the whole network (full_q8n)."""
+    from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+    cfg, _model, variables = tiny_detector
+    runner = DetectorRunner(
+        cfg, variables, batch_size=1, with_proposals=False,
+        int8_head=True, int8_network=True,
+    )
+    runner.warmup()
+    return runner
+
+
+class TestFullNetworkQ8:
+    def test_quantize_network_per_layer_budget(self, tiny_detector):
+        # EVERY conv/dense kernel is quantized and reconstructs within
+        # the symmetric-int8 bound (|w - deq| <= scale/2 per channel);
+        # biases and BN constants pass through bit-identical.
+        from mx_rcnn_tpu.serve.quantize import (
+            dequantize_network,
+            is_quantized_leaf,
+            quantize_network,
+        )
+        from mx_rcnn_tpu.utils.precision import dequantize
+
+        _cfg, _model, variables = tiny_detector
+        qnet = quantize_network(variables)
+
+        def descend(tree, path):
+            node = tree
+            for k in path:
+                key = getattr(k, "key", None)
+                if key is None:
+                    key = getattr(k, "name", None)
+                node = node[key]
+            return node
+
+        leaves = jax.tree_util.tree_flatten_with_path(variables)[0]
+        n_quantized = 0
+        for path, w in leaves:
+            node = descend(qnet, path)
+            if is_quantized_leaf(node):
+                n_quantized += 1
+                assert np.asarray(node["q"]).dtype == np.int8
+                scale = np.asarray(node["scale"])
+                deq = np.asarray(
+                    dequantize(node["q"], node["scale"], jnp.float32)
+                )
+                assert np.all(
+                    np.abs(deq - np.asarray(w)) <= scale / 2.0 + 1e-7
+                ), [getattr(k, "key", k) for k in path]
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(node), np.asarray(w)
+                )
+        # backbone + FPN + RPN + heads: a real network's worth of layers.
+        assert n_quantized >= 20
+        deq_tree = dequantize_network(qnet)
+        assert (
+            jax.tree_util.tree_structure(deq_tree)
+            == jax.tree_util.tree_structure(variables)
+        )
+
+    def test_q8n_ladder_between_q8_and_reduced(self):
+        from mx_rcnn_tpu.serve import LEVELS
+        from mx_rcnn_tpu.serve.degrade import FULL_QUALITY_LEVELS
+
+        i = {lvl: n for n, lvl in enumerate(LEVELS)}
+        assert i["full_q8"] < i["full_q8n"] < i["reduced"]
+        # q8 levels are degraded quality: the breaker must keep steering
+        # half-open probes at full/small only.
+        assert "full_q8" not in FULL_QUALITY_LEVELS
+        assert "full_q8n" not in FULL_QUALITY_LEVELS
+
+    def test_q8_programs_register_per_bucket(self, tiny_detector):
+        # Regression: full_q8/full_q8n used to compile ONLY the smallest
+        # bucket, so large images silently recompiled on the serving
+        # path.  Every bucket must have its own q8 program, and the
+        # LARGEST bucket must actually serve.
+        from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+        cfg, _model, variables = tiny_detector
+        runner = DetectorRunner(
+            cfg, variables, buckets=((64, 64), (96, 128)), batch_size=1,
+            with_proposals=False, int8_head=True, int8_network=True,
+        )
+        for b in runner.buckets:
+            assert ("full_q8", b) in runner._program_keys
+            assert ("full_q8n", b) in runner._program_keys
+        assert runner.warmup() == len(runner._program_keys)
+        big = runner.buckets[-1]
+        img = np.random.RandomState(7).randint(
+            0, 255, (big[0], big[1], 3), np.uint8
+        ).astype(np.float32)
+        out = runner.run("full_q8", big, [img])[0]
+        assert set(out) >= {"boxes", "scores", "classes"}
+
+    def test_q8n_map_parity_with_f32(self, q8n_runner):
+        # The PTQ acceptance gate: score full_q8n detections against the
+        # f32 program's detections as ground truth.  Weight-only int8
+        # perturbs scores/boxes slightly (the per-layer budget above),
+        # but detection-level agreement must stay high.
+        from mx_rcnn_tpu.evalutil.voc_eval import voc_eval
+
+        rng = np.random.RandomState(3)
+        imgs = [
+            rng.randint(0, 255, (96, 128, 3), np.uint8).astype(np.float32)
+            for _ in range(4)
+        ]
+        b = q8n_runner.buckets[0]
+
+        def detect(level):
+            out = {}
+            for i, im in enumerate(imgs):
+                r = q8n_runner.run(level, b, [im])[0]
+                out[i] = {
+                    k: np.asarray(r[k])
+                    for k in ("boxes", "scores", "classes")
+                }
+            return out
+
+        d32, dq8 = detect("full"), detect("full_q8n")
+        classes = sorted({
+            int(c) for i in range(len(imgs))
+            for c in d32[i]["classes"][d32[i]["scores"] > 0.05]
+        })
+        assert classes, "f32 reference produced no detections"
+        aps = []
+        for c in classes:
+            det, gt = {}, {}
+            for i in range(len(imgs)):
+                m32 = (d32[i]["scores"] > 0.05) & (d32[i]["classes"] == c)
+                mq8 = (dq8[i]["scores"] > 0.05) & (dq8[i]["classes"] == c)
+                gt[str(i)] = {"boxes": d32[i]["boxes"][m32]}
+                det[str(i)] = np.concatenate(
+                    [dq8[i]["boxes"][mq8], dq8[i]["scores"][mq8, None]],
+                    axis=1,
+                )
+            aps.append(voc_eval(det, gt)[0])
+        assert float(np.mean(aps)) >= 0.85, aps
+
+    def test_runner_q8n_serves_and_swaps(self, q8n_runner):
+        assert q8n_runner.levels() == (
+            "full", "full_q8", "full_q8n", "reduced"
+        )
+        img = np.random.RandomState(5).randint(
+            0, 255, (96, 128, 3), np.uint8
+        ).astype(np.float32)
+        out = q8n_runner.run("full_q8n", q8n_runner.buckets[0], [img])[0]
+        assert set(out) >= {"boxes", "scores", "classes"}
+        assert out["generation"] == q8n_runner.generation
+
+
+# ---------------------------------------------------------------------------
+# fused inference middle through the serving programs (r16 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestFusedServingMiddle:
+    @pytest.mark.slow
+    def test_fused_middle_bitwise_parity_per_program(
+        self, tiny_detector, monkeypatch
+    ):
+        # serve.fused_middle=on rewrites the model config EVERY serving
+        # program traces from; the fused Pallas middle is bit-identical
+        # to the dense chain, so each program's response must match the
+        # fused_middle=off build bitwise.  Interpret mode runs the real
+        # kernel on CPU (same contract as training).
+        from mx_rcnn_tpu.detection import graph as graph_mod
+        from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+        monkeypatch.setenv("MX_RCNN_PALLAS_INTERPRET", "1")
+        cfg, _model, variables = tiny_detector
+
+        def build(mode):
+            c = apply_overrides(cfg, [f"serve.fused_middle={mode}"])
+            r = DetectorRunner(
+                c, variables, batch_size=1, with_proposals=False
+            )
+            r.warmup()
+            return r
+
+        off = build("off")
+        assert graph_mod.LAST_MIDDLE_IMPL == "xla"
+        on = build("on")
+        assert graph_mod.LAST_MIDDLE_IMPL == "fused"
+        img = np.random.RandomState(13).randint(
+            0, 255, (96, 128, 3), np.uint8
+        ).astype(np.float32)
+        for level in ("full", "reduced"):
+            a = on.run(level, on.buckets[0], [img])[0]
+            b = off.run(level, off.buckets[0], [img])[0]
+            for k in ("boxes", "scores", "classes"):
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k]), err_msg=(level, k)
+                )
+
+    def test_fused_middle_knob_validates(self, tiny_detector):
+        from mx_rcnn_tpu.serve.engine import DetectorRunner
+
+        cfg, _model, variables = tiny_detector
+        bad = apply_overrides(cfg, ["serve.fused_middle=maybe"])
+        with pytest.raises(ValueError, match="fused_middle"):
+            DetectorRunner(bad, variables, batch_size=1)
+
+
+# ---------------------------------------------------------------------------
+# content-addressed result cache (r16 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class TestResultCacheServing:
+    def test_cache_hit_bitwise_equals_cold_miss(self, q8n_runner):
+        # A hit returns the very response a cold call latched (minus
+        # per-call placement metadata), so it is bitwise-identical by
+        # construction — proven here through a REAL single-replica fleet.
+        from mx_rcnn_tpu.serve import (
+            FleetRouter,
+            InferenceEngine,
+            ResultCache,
+        )
+
+        cache = ResultCache(capacity=4)
+        fleet = FleetRouter(
+            lambda rid: InferenceEngine(q8n_runner, replica_id=rid),
+            1, supervisor_poll=0.05, result_cache=cache,
+        )
+        img = np.random.RandomState(11).randint(
+            0, 255, (96, 128, 3), np.uint8
+        ).astype(np.float32)
+        with fleet:
+            cold = fleet.submit(img, timeout=60).result(60)
+            hit = fleet.submit(img, timeout=60).result(60)
+        assert not cold.get("cached")
+        assert hit["cached"] is True
+        assert hit["level"] == cold["level"]
+        for k in ("boxes", "scores", "classes"):
+            np.testing.assert_array_equal(
+                np.asarray(hit[k]), np.asarray(cold[k])
+            )
+        # Placement metadata describes the cold call, not the answer.
+        assert "replica_id" not in hit and "latency_s" not in hit
+        assert cache.stats()["hits"] == 1
+
+    def test_coalescing_is_one_device_call(self):
+        # N identical in-flight requests: one leader reaches the device,
+        # followers latch its response when it settles.
+        import threading
+
+        from test_serve import FakeRunner, _img
+
+        from mx_rcnn_tpu.serve import (
+            FleetRouter,
+            InferenceEngine,
+            ResultCache,
+        )
+
+        gate = threading.Event()
+        runner = FakeRunner(block=gate)
+        cache = ResultCache(capacity=4)
+        fleet = FleetRouter(
+            lambda rid: InferenceEngine(runner, replica_id=rid),
+            1, supervisor_poll=0.05, result_cache=cache,
+        )
+        with fleet:
+            runs_before = len(runner.run_calls)
+            reqs = [fleet.submit(_img(16, 16), timeout=30)
+                    for _ in range(3)]
+            gate.set()
+            results = [r.result(30) for r in reqs]
+        assert len(runner.run_calls) - runs_before == 1
+        assert sum(1 for r in results if r.get("coalesced")) == 2
+        st = cache.stats()
+        assert st["coalesced"] == 2 and st["inserts"] == 1
+        s = fleet.stats()
+        assert s["completed"] == 3 and s["failed"] == 0
+
+    def test_generation_roll_invalidates(self):
+        from test_serve import FakeRunner, _img
+
+        from mx_rcnn_tpu.serve import (
+            FleetRouter,
+            InferenceEngine,
+            ResultCache,
+        )
+
+        cache = ResultCache(capacity=4)
+        fleet = FleetRouter(
+            lambda rid: InferenceEngine(
+                FakeRunner(), replica_id=rid
+            ),
+            1, supervisor_poll=0.05, result_cache=cache,
+        )
+        with fleet:
+            fleet.submit(_img(16, 16), timeout=30).result(30)
+            assert fleet.submit(
+                _img(16, 16), timeout=30
+            ).result(30)["cached"] is True
+            fleet.swap_weights({"params": {}})
+            post = fleet.submit(_img(16, 16), timeout=30).result(30)
+        assert not post.get("cached")
+        assert cache.stats()["size"] == 1  # stale generation dropped
+
+    def test_content_key_separates_dtype_and_shape(self):
+        from mx_rcnn_tpu.serve import content_key
+
+        a = np.zeros((4, 4, 3), np.uint8)
+        assert content_key(a) == content_key(a.copy())
+        assert content_key(a) != content_key(a.astype(np.float32))
+        assert content_key(a) != content_key(
+            np.zeros((4, 12), np.uint8)
+        )
+        assert content_key("not an image") is None
+
+
+# ---------------------------------------------------------------------------
 # TPU006 upcast walk (unit level; the full invariant runs in test_tpulint)
 # ---------------------------------------------------------------------------
 

@@ -124,12 +124,20 @@ def _pack_section(journal: list[dict]) -> dict:
             "mean": round(filled / calls, 4) if calls else None,
         }
     for name in ("data_shm_bytes_total", "data_shm_ring_stalls_total",
-                 "data_shm_quarantines_total"):
+                 "data_shm_quarantines_total",
+                 "serve_cache_hits_total", "serve_cache_coalesced_total",
+                 "serve_cache_evictions_total"):
         series = snap.get(name)
         if isinstance(series, dict) and series:
             out[name] = round(sum(
                 v for v in series.values() if isinstance(v, (int, float))
             ), 2)
+    size = snap.get("serve_cache_size")
+    if isinstance(size, dict) and size:
+        # Gauge: last value wins per series; one shared cache per router.
+        vals = [v for v in size.values() if isinstance(v, (int, float))]
+        if vals:
+            out["serve_cache_size"] = vals[-1]
     return out
 
 

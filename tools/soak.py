@@ -368,6 +368,14 @@ def run_soak(args: argparse.Namespace) -> dict:
             for name, series in obs.registry().snapshot().items()
             if name.startswith("data_shm_") and series
         },
+        # Result-cache counters (serve/result_cache.py); empty when the
+        # soak fleet runs cache-off (the default — coalescing would mask
+        # the queue pressure the autoscaler story asserts on).
+        "cache": {
+            name: round(sum(series.values()), 2)
+            for name, series in obs.registry().snapshot().items()
+            if name.startswith("serve_cache_") and series
+        },
         "slo": {
             "fast_s": round(fast_s, 2),
             "slow_s": round(slow_s, 2),
