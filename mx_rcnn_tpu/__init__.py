@@ -23,6 +23,20 @@ Layers (bottom-up, see SURVEY.md section 8):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# Runtime lock-order sanitizer (analysis/lockcheck.py), env-gated so the
+# one variable activates it in every process of a run — chaos children,
+# serve hosts, data workers — with no per-entry-point wiring.  Must
+# install BEFORE any module creates its locks; package import is the
+# earliest common point.  Unset (the default) this is one getenv.
+if _os.environ.get("MX_RCNN_LOCKCHECK") == "1":
+    from mx_rcnn_tpu.analysis import lockcheck as _lockcheck
+
+    _lockcheck.install()
+    del _lockcheck
+del _os
+
 import jax as _jax
 
 # Sharding-invariant PRNG, unconditionally.  The legacy threefry lowering

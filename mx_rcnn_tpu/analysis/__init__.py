@@ -19,9 +19,24 @@ the machinery that *checks* that claim instead of assuming it:
   to the train state, and >=99% of conv/dot FLOPs attributed to a named
   component by :mod:`mx_rcnn_tpu.utils.hlo_profile`.
 
-``tools/tpulint.py`` is the CLI (writes ``artifacts/tpulint_report.json``);
-``tests/test_tpulint.py`` runs both layers as tier-1 tests.  See
-``docs/static_analysis.md`` for the rule set and extension guide.
+* :mod:`fleetlint` (layer 3) — concurrency + contract lint for the
+  threaded host-side plane (``serve/ obs/ ctrl/ data/ tools/``):
+  lock-acquisition-order cycles, bare acquires, undaemonized threads,
+  unlocked shared writes from thread targets, blocking calls under
+  locks (FL001–FL005), plus the serve typed-error vocabulary, the
+  journal-kind/metric registry and the cfg-knob docs contracts
+  (FL010–FL012).  Own ratchet baseline (``fleetlint_baseline.json``).
+
+* :mod:`lockcheck` (runtime twin of layer 3) — opt-in instrumented
+  ``threading.Lock/RLock`` (env ``MX_RCNN_LOCKCHECK=1``) that enforces
+  the acquisition-order graph and the no-blocking-under-lock rule at
+  runtime, deterministically, without needing a real deadlock.
+
+``tools/tpulint.py`` and ``tools/fleetlint.py`` are the CLIs (writing
+``artifacts/tpulint_report.json`` / ``artifacts/fleetlint_report.json``);
+``tests/test_tpulint.py`` and ``tests/test_fleetlint.py`` run the layers
+as tier-1 tests.  See ``docs/static_analysis.md`` for the rule sets and
+extension guide.
 """
 
 from mx_rcnn_tpu.analysis.ast_lint import (
@@ -45,7 +60,15 @@ from mx_rcnn_tpu.analysis.jaxpr_checks import (
     run_jaxpr_checks,
 )
 
+# Layer 3 + its runtime twin, as submodules: fleetlint deliberately
+# reuses the names Finding/RULES/lint_paths for its own rule family, so
+# the flat namespace stays tpulint's and layer 3 is reached as
+# ``analysis.fleetlint.*`` / ``analysis.lockcheck.*``.
+from mx_rcnn_tpu.analysis import fleetlint, lockcheck
+
 __all__ = [
+    "fleetlint",
+    "lockcheck",
     "Finding",
     "RULES",
     "TRACED_PREFIXES",

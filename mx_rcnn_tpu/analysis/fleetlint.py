@@ -1,0 +1,845 @@
+"""fleetlint (layer 3): concurrency + contract lint for the host-side plane.
+
+tpulint (:mod:`ast_lint` + :mod:`jaxpr_checks`) proves the *traced* half
+of the repo is TPU-clean; this module covers the other half — the
+threaded serving control plane in ``serve/``, ``obs/``, ``ctrl/``,
+``data/`` and ``tools/`` — whose worst bugs are concurrency bugs that no
+jaxpr can show.  Same discipline as tpulint: AST rules with stable IDs,
+a committed fingerprint baseline that only ratchets down
+(``fleetlint_baseline.json``), ``tools/fleetlint.py --check`` as the CLI
+and ``tests/test_fleetlint.py`` as the tier-1 gate.
+
+Concurrency rules (per file):
+
+* FL001 — lock-acquisition-order cycle.  Builds the order graph from
+  ``with <lock>:`` nesting plus a one-level call-graph closure
+  (``with self._a: self.m()`` where ``m`` acquires ``self._b`` adds the
+  edge ``a -> b``), then flags every edge that participates in a cycle.
+* FL002 — bare ``.acquire()`` on a lock without a ``try/finally``
+  ``.release()`` in the same function.
+* FL003 — ``threading.Thread`` without an explicit ``daemon=`` and with
+  no visible ``.join()``/stop path for the created thread.
+* FL004 — attribute written from a thread-target method outside any
+  lock, but read from another method also outside any lock, in a class
+  that owns locks (i.e. the class has a locking discipline and this
+  attribute escaped it).
+* FL005 — blocking call while a lock is held: ``urlopen``, bare
+  ``.get()``/``.result()``/``.wait()``/``.join()`` without a timeout,
+  and weight-push calls (``.swap_weights()``/``.swap()``).
+
+Contract rules (repo-level, :func:`contract_findings`):
+
+* FL010 — ``raise``/``except`` in ``serve/`` outside the typed-error
+  vocabulary, and the RPC status map in ``serve/rpc.py`` must be total
+  over the serve error vocabulary in both directions.
+* FL011 — every literal journal kind passed to ``obs.emit`` must have a
+  template in ``obs/events.py``; every metric name created via
+  ``obs.counter/gauge/histogram`` must be listed in the
+  ``docs/observability.md`` inventory; every metric
+  ``tools/obs_report.py`` consumes must actually be produced somewhere.
+* FL012 — every ``cfg.<section>.<knob>`` read (serve/ctrl/obs/data/
+  fabric) must exist as a field on the matching dataclass in
+  ``config.py`` and appear in a docs table.
+
+The runtime twin of FL001/FL005 is :mod:`mx_rcnn_tpu.analysis.lockcheck`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Iterable, Optional
+
+__all__ = [
+    "FLEET_PREFIXES",
+    "RULES",
+    "Finding",
+    "fleet_files",
+    "lint_source",
+    "lint_paths",
+    "contract_findings",
+]
+
+# Repo-relative prefixes the concurrency rules run over (trailing "/" =
+# subtree).  The contract rules additionally scan train/ for journal
+# kinds — training emits into the same journal.
+FLEET_PREFIXES = (
+    "mx_rcnn_tpu/serve/",
+    "mx_rcnn_tpu/obs/",
+    "mx_rcnn_tpu/ctrl/",
+    "mx_rcnn_tpu/data/",
+    "tools/",
+)
+CONTRACT_EXTRA_PREFIXES = ("mx_rcnn_tpu/train/",)
+
+RULES = {
+    "FL001": "lock-acquisition-order cycle (deadlock by interleaving)",
+    "FL002": "bare .acquire() without a try/finally .release()",
+    "FL003": "threading.Thread without explicit daemon= or a join()/stop "
+             "path",
+    "FL004": "attribute written from a thread target outside any lock "
+             "but read elsewhere outside any lock",
+    "FL005": "blocking call while a lock is held",
+    "FL010": "raise/except outside the serve typed-error vocabulary, or "
+             "RPC status map not total over it",
+    "FL011": "journal kind missing from obs/events.py, or metric name "
+             "missing from the registry docs / never produced",
+    "FL012": "cfg knob read that is missing from config.py or "
+             "undocumented",
+}
+
+_LOCKISH_RE = re.compile(r"(?:^|_)(lock|mutex|mu|cond|cv)\d*$", re.I)
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+# FL010 vocabularies. Typed serve errors + the builtins that express
+# programming/usage errors (they surface as 500s on purpose).
+RAISE_ALLOW = frozenset({
+    "ServeError", "Overloaded", "EngineUnavailable", "DeadlineExceeded",
+    "HostUnreachable",
+    "ValueError", "TypeError", "KeyError", "RuntimeError", "TimeoutError",
+    "NotImplementedError", "AssertionError", "OSError", "StopIteration",
+    "_error",  # serve handler-local typed-error factory
+})
+EXCEPT_ALLOW = RAISE_ALLOW | frozenset({
+    "Exception", "BaseException", "Empty", "Full", "HTTPError",
+    "URLError", "ConnectionError", "ConnectionRefusedError",
+    "ConnectionResetError", "BrokenPipeError", "InterruptedError",
+    "BlockingIOError", "AttributeError", "IndexError", "OverflowError",
+    "ZeroDivisionError", "FileNotFoundError", "JSONDecodeError",
+})
+
+# FL005: attribute calls that are blocking regardless of arguments.
+_ALWAYS_BLOCKING_ATTRS = {"urlopen", "swap_weights", "swap"}
+# FL005: attribute calls that block when called with no timeout.
+_TIMEOUT_BLOCKING_ATTRS = {"get", "result", "wait", "join"}
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(
+    r"^(serve|data|fleet|obs|slo|ctrl|train|gateway|gossip|rpc)"
+    r"_[a-z0-9_]+$"
+)
+_CFG_SECTIONS = {"serve", "ctrl", "obs", "data", "fabric"}
+_CFG_CLASS_BY_SECTION = {
+    "serve": "ServeConfig", "ctrl": "CtrlConfig", "obs": "ObsConfig",
+    "data": "DataConfig", "fabric": "FabricConfig",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    snippet: str
+    message: str
+
+    def fingerprint(self) -> str:
+        # Deliberately excludes the line number: moving code around does
+        # not create "new" findings, editing the flagged line does.
+        key = f"{self.rule}:{self.path}:{self.snippet}"
+        return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message}\n    {self.snippet}"
+        )
+
+
+def is_fleet_path(rel_path: str) -> bool:
+    p = rel_path.replace(os.sep, "/")
+    return any(
+        p.startswith(pref) if pref.endswith("/") else p == pref
+        for pref in FLEET_PREFIXES
+    )
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+def _attr_name(node: ast.expr) -> Optional[str]:
+    """'EngineUnavailable' for both ``Name`` and ``x.EngineUnavailable``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_lockish_expr(expr: ast.expr, class_locks: set[str]) -> bool:
+    """Does this with-item / receiver look like a lock?"""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in class_locks or bool(
+            _LOCKISH_RE.search(expr.attr)
+        )
+    if isinstance(expr, ast.Name):
+        return bool(_LOCKISH_RE.search(expr.id))
+    return False
+
+
+def _is_lock_factory_call(value: ast.expr) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / ``threading.Condition(...)``."""
+    if not isinstance(value, ast.Call):
+        return False
+    name = _attr_name(value.func)
+    return name in _LOCK_FACTORIES
+
+
+class _FnInfo:
+    """Per-function facts collected during the walk."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.acquires: set[str] = set()        # lock keys acquired via with
+        self.calls_under: list = []            # (held_key, method, node)
+        self.acquire_calls: list = []          # (recv_key, node)  bare .acquire
+        self.finally_releases: set[str] = set()
+        self.writes_nolock: dict[str, ast.AST] = {}  # self.attr = .. no lock
+        self.reads_nolock: set[str] = set()
+
+
+class _FileLint(ast.NodeVisitor):
+    """One pass over one file: FL001–FL005 (+ FL010 raise/except in
+    serve/)."""
+
+    def __init__(self, path: str, src_lines: list[str]) -> None:
+        self.path = path
+        self.src_lines = src_lines
+        self.findings: list[Finding] = []
+        self.in_serve = path.startswith("mx_rcnn_tpu/serve/")
+        self._class: list[str] = []            # class name stack
+        self._class_locks: list[set[str]] = []  # lock attr names per class
+        self._fns: list[dict[str, _FnInfo]] = []  # per-class method infos
+        self._thread_targets: list[set[str]] = []  # per-class target methods
+        self._edges: list[dict] = []           # per-class {(A,B): node}
+        self._fn: list[_FnInfo] = []           # function stack
+        self._held: list[str] = []             # lock keys held (lexically)
+        self._src = "\n".join(src_lines)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str = "") -> None:
+        line = getattr(node, "lineno", 1)
+        snippet = ""
+        if 0 < line <= len(self.src_lines):
+            snippet = self.src_lines[line - 1].strip()
+        self.findings.append(Finding(
+            rule=rule, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), snippet=snippet,
+            message=message or RULES[rule],
+        ))
+
+    def _lock_key(self, expr: ast.expr) -> str:
+        owner = self._class[-1] if self._class else "<module>"
+        return f"{owner}.{_unparse(expr)}"
+
+    def _cur_class_locks(self) -> set[str]:
+        return self._class_locks[-1] if self._class_locks else set()
+
+    # -- scopes ----------------------------------------------------------
+
+    def _prescan_class_locks(self, node: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and _is_lock_factory_call(
+                sub.value
+            ):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        locks.add(tgt.attr)
+        return locks
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class.append(node.name)
+        self._class_locks.append(self._prescan_class_locks(node))
+        self._fns.append({})
+        self._thread_targets.append(set())
+        self._edges.append({})
+        self.generic_visit(node)
+        self._finish_class()
+        self._class.pop()
+        self._class_locks.pop()
+        self._fns.pop()
+        self._thread_targets.pop()
+        self._edges.pop()
+
+    def _finish_class(self) -> None:
+        fns = self._fns[-1]
+        edges = self._edges[-1]
+        # One-level call closure: held A, call self.m(), m acquires B.
+        for info in fns.values():
+            for held_key, meth, call_node in info.calls_under:
+                callee = fns.get(meth)
+                if callee is None:
+                    continue
+                for b in callee.acquires:
+                    if b != held_key and (held_key, b) not in edges:
+                        edges[(held_key, b)] = call_node
+        # Cycle detection: flag every edge whose reverse is reachable.
+        adj: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reachable(src: str, dst: str) -> bool:
+            stack, seen = [src], set()
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(adj.get(n, ()))
+            return False
+
+        for (a, b), node in sorted(
+            edges.items(), key=lambda kv: getattr(kv[1], "lineno", 0)
+        ):
+            if reachable(b, a):
+                self._emit(
+                    "FL001", node,
+                    f"lock-order cycle: {a} -> {b} inverts an existing "
+                    f"{b} ->* {a} ordering",
+                )
+        # FL004: unlocked writes from thread targets vs unlocked reads.
+        if not self._cur_class_locks():
+            return
+        targets = self._thread_targets[-1]
+        for meth in sorted(targets):
+            info = fns.get(meth)
+            if info is None:
+                continue
+            for attr, wnode in sorted(info.writes_nolock.items()):
+                if _LOCKISH_RE.search(attr):
+                    continue
+                for other_name, other in fns.items():
+                    if other_name in (meth, "__init__"):
+                        continue
+                    if attr in other.reads_nolock:
+                        self._emit(
+                            "FL004", wnode,
+                            f"self.{attr} written in thread target "
+                            f"{meth}() without a lock but read in "
+                            f"{other_name}() without a lock",
+                        )
+                        break
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn.append(_FnInfo(node.name))
+        held_before = list(self._held)
+        self._held = []  # lock scopes don't cross function boundaries
+        self.generic_visit(node)
+        self._held = held_before
+        info = self._fn.pop()
+        if self._fns:
+            self._fns[-1][node.name] = info
+        # FL002 resolution: every bare acquire needs a finally release.
+        for recv_key, call_node in info.acquire_calls:
+            if recv_key not in info.finally_releases:
+                self._emit(
+                    "FL002", call_node,
+                    f"{recv_key}.acquire() without try/finally "
+                    f"{recv_key}.release()",
+                )
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- the rules -------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        keys = []
+        for item in node.items:
+            expr = item.context_expr
+            if _is_lockish_expr(expr, self._cur_class_locks()):
+                key = self._lock_key(expr)
+                if self._held and self._held[-1] != key and self._edges:
+                    edge = (self._held[-1], key)
+                    self._edges[-1].setdefault(edge, node)
+                if self._fn:
+                    self._fn[-1].acquires.add(key)
+                keys.append(key)
+                self._held.append(key)
+            else:
+                self.visit(expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in keys:
+            self._held.pop()
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if self._fn:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "release"):
+                        self._fn[-1].finally_releases.add(
+                            self._lock_key(sub.func.value)
+                        )
+        self.generic_visit(node)
+
+    def _check_thread_ctor(self, node: ast.Call) -> None:
+        kwargs = {kw.arg for kw in node.keywords if kw.arg}
+        # Record thread-target methods for FL004 regardless of daemon=.
+        for kw in node.keywords:
+            if (kw.arg == "target"
+                    and isinstance(kw.value, ast.Attribute)
+                    and isinstance(kw.value.value, ast.Name)
+                    and kw.value.value.id == "self"
+                    and self._thread_targets):
+                self._thread_targets[-1].add(kw.value.attr)
+        if "daemon" in kwargs:
+            return
+        # No explicit daemon=: require a visible join()/stop path for
+        # whatever name the thread is bound to.
+        parent = getattr(node, "_fl_parent", None)
+        bound: Optional[str] = None
+        if isinstance(parent, ast.Assign) and parent.targets:
+            tgt = parent.targets[0]
+            if isinstance(tgt, ast.Name):
+                bound = tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                bound = tgt.attr
+        if bound and (
+            f"{bound}.join(" in self._src or f"{bound}.daemon" in self._src
+        ):
+            return
+        self._emit("FL003", node)
+
+    def _has_timeout(self, node: ast.Call) -> bool:
+        if node.args:
+            return True
+        return any(kw.arg == "timeout" for kw in node.keywords)
+
+    def _check_blocking_under_lock(self, node: ast.Call) -> None:
+        func = node.func
+        name = _attr_name(func)
+        if name == "urlopen":
+            self._emit(
+                "FL005", node,
+                f"urlopen while holding {self._held[-1]}",
+            )
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        if name in _ALWAYS_BLOCKING_ATTRS:
+            self._emit(
+                "FL005", node,
+                f".{name}() while holding {self._held[-1]}",
+            )
+            return
+        if name in _TIMEOUT_BLOCKING_ATTRS and not self._has_timeout(node):
+            recv_key = self._lock_key(func.value)
+            if name == "wait" and recv_key in self._held:
+                return  # Condition.wait on the held condition: releases it
+            if name == "get" and not (
+                isinstance(func.value, (ast.Name, ast.Attribute))
+            ):
+                return
+            self._emit(
+                "FL005", node,
+                f".{name}() with no timeout while holding "
+                f"{self._held[-1]}",
+            )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _attr_name(node.func)
+        if name == "Thread":
+            self._check_thread_ctor(node)
+        if name == "acquire" and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if _is_lockish_expr(recv, self._cur_class_locks()) and self._fn:
+                self._fn[-1].acquire_calls.append(
+                    (self._lock_key(recv), node)
+                )
+        if self._held:
+            self._check_blocking_under_lock(node)
+            # One-level closure input: self.m() under a held lock.
+            if (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and self._fn):
+                self._fn[-1].calls_under.append(
+                    (self._held[-1], node.func.attr, node)
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            node.value._fl_parent = node  # type: ignore[attr-defined]
+        self._record_write(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node, [node.target])
+        self.generic_visit(node)
+
+    def _record_write(self, node: ast.AST, targets: list) -> None:
+        if self._held or not self._fn:
+            return
+        for tgt in targets:
+            base = tgt
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                self._fn[-1].writes_nolock.setdefault(base.attr, node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (not self._held and self._fn
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self._fn[-1].reads_nolock.add(node.attr)
+        self.generic_visit(node)
+
+    # -- FL010 (serve/ only) --------------------------------------------
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if self.in_serve and node.exc is not None:
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                name = _attr_name(exc.func)
+                # `raise _ERROR_TYPES.get(...)(msg)` and other dynamic
+                # constructors are out of static reach — skip those.
+                if name is not None and not isinstance(exc.func, ast.Call):
+                    if name not in RAISE_ALLOW:
+                        self._emit(
+                            "FL010", node,
+                            f"raise {name}(...) is outside the serve "
+                            f"typed-error vocabulary",
+                        )
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self.in_serve and node.type is not None:
+            types = (node.type.elts
+                     if isinstance(node.type, ast.Tuple) else [node.type])
+            for t in types:
+                name = _attr_name(t)
+                if name is not None and name not in EXCEPT_ALLOW:
+                    self._emit(
+                        "FL010", node,
+                        f"except {name} is outside the serve typed-error "
+                        f"vocabulary",
+                    )
+        self.generic_visit(node)
+
+
+def lint_source(src: str, path: str) -> list[Finding]:
+    """Concurrency-lint one file; ``path`` decides scoping.  Returns []
+    for paths outside the fleet prefixes."""
+    if not is_fleet_path(path):
+        return []
+    tree = ast.parse(src, filename=path)
+    linter = _FileLint(path.replace(os.sep, "/"), src.splitlines())
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def fleet_files(repo_root: str) -> list[str]:
+    """All repo-relative python files under the fleet prefixes."""
+    out = []
+    for pref in FLEET_PREFIXES:
+        full = os.path.join(repo_root, pref)
+        if not os.path.isdir(full):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(full):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, name), repo_root
+                    )
+                    out.append(rel.replace(os.sep, "/"))
+    return out
+
+
+# -- contract checks (repo-level) ---------------------------------------------
+
+
+def _read_sources(
+    repo_root: str,
+    rel_paths: Iterable[str],
+    overlay: Optional[dict] = None,
+) -> dict[str, str]:
+    srcs: dict[str, str] = {}
+    for rel in rel_paths:
+        if overlay and rel in overlay:
+            srcs[rel] = overlay[rel]
+            continue
+        full = os.path.join(repo_root, rel)
+        if os.path.exists(full):
+            with open(full) as f:
+                srcs[rel] = f.read()
+    if overlay:
+        for rel, src in overlay.items():
+            srcs.setdefault(rel, src)
+    return srcs
+
+
+def _mk(rule: str, path: str, line: int, snippet: str,
+        message: str) -> Finding:
+    return Finding(rule=rule, path=path, line=line, col=0,
+                   snippet=snippet, message=message)
+
+
+def _line_at(src: str, line: int) -> str:
+    lines = src.splitlines()
+    if 0 < line <= len(lines):
+        return lines[line - 1].strip()
+    return ""
+
+
+def _events_kinds(events_src: str) -> set[str]:
+    """Keys of the EVENTS dict literal in obs/events.py."""
+    kinds: set[str] = set()
+    tree = ast.parse(events_src)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                   else node.target)
+            if (isinstance(tgt, ast.Name) and tgt.id == "EVENTS"
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str
+                    ):
+                        kinds.add(k.value)
+    return kinds
+
+
+def _serve_error_vocab(engine_src: str) -> set[str]:
+    """Names of ServeError subclasses defined in serve/engine.py."""
+    out: set[str] = set()
+    for node in ast.walk(ast.parse(engine_src)):
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                if _attr_name(base) == "ServeError":
+                    out.add(node.name)
+    return out
+
+
+def _dict_literal_keys(src: str, var_name: str) -> tuple[set[str], int]:
+    """(string keys, line) of a module-level dict literal assignment."""
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name) and tgt.id == var_name
+                        and isinstance(node.value, ast.Dict)):
+                    keys = {
+                        k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                    return keys, node.lineno
+    return set(), 1
+
+
+def _config_fields(config_src: str) -> dict[str, set[str]]:
+    """section -> annotated field names, from config.py dataclasses."""
+    by_class: dict[str, set[str]] = {}
+    for node in ast.walk(ast.parse(config_src)):
+        if isinstance(node, ast.ClassDef):
+            fields = {
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+            by_class[node.name] = fields
+    return {
+        section: by_class.get(cls, set())
+        for section, cls in _CFG_CLASS_BY_SECTION.items()
+    }
+
+
+def contract_findings(
+    repo_root: str, overlay: Optional[dict] = None
+) -> list[Finding]:
+    """FL010/FL011/FL012 over the whole plane.  ``overlay`` maps
+    repo-relative paths to source text that replaces (or extends) what is
+    on disk — used by tests to seed violations without touching files."""
+    findings: list[Finding] = []
+    scan_paths = fleet_files(repo_root)
+    for pref in CONTRACT_EXTRA_PREFIXES:
+        full = os.path.join(repo_root, pref)
+        if os.path.isdir(full):
+            for dirpath, _d, names in os.walk(full):
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, name), repo_root
+                        )
+                        scan_paths.append(rel.replace(os.sep, "/"))
+    srcs = _read_sources(repo_root, scan_paths, overlay)
+
+    aux = _read_sources(repo_root, (
+        "mx_rcnn_tpu/obs/events.py",
+        "mx_rcnn_tpu/serve/engine.py",
+        "mx_rcnn_tpu/serve/rpc.py",
+        "mx_rcnn_tpu/config.py",
+        "tools/obs_report.py",
+    ), overlay)
+    docs = _read_sources(repo_root, (
+        "docs/observability.md", "docs/static_analysis.md",
+        "docs/serving.md", "docs/data_plane.md", "docs/fabric.md",
+        "README.md",
+    ), overlay)
+    registry_docs = docs.get("docs/observability.md", "")
+    all_docs = "\n".join(docs.values())
+
+    # FL010 — status-map totality, both directions.
+    vocab = _serve_error_vocab(aux.get("mx_rcnn_tpu/serve/engine.py", ""))
+    rpc_src = aux.get("mx_rcnn_tpu/serve/rpc.py", "")
+    for var in ("_ERROR_STATUS", "_ERROR_TYPES"):
+        keys, line = _dict_literal_keys(rpc_src, var)
+        if not keys:
+            continue
+        missing = vocab - keys
+        extra = keys - vocab
+        if missing:
+            findings.append(_mk(
+                "FL010", "mx_rcnn_tpu/serve/rpc.py", line,
+                _line_at(rpc_src, line),
+                f"{var} is missing typed error(s) {sorted(missing)} — "
+                f"they would degrade to generic 500s on the wire",
+            ))
+        if extra:
+            findings.append(_mk(
+                "FL010", "mx_rcnn_tpu/serve/rpc.py", line,
+                _line_at(rpc_src, line),
+                f"{var} maps unknown error name(s) {sorted(extra)} not "
+                f"defined in serve/engine.py",
+            ))
+
+    # FL011 — journal kinds + metric registry.
+    kinds = _events_kinds(aux.get("mx_rcnn_tpu/obs/events.py", ""))
+    produced_metrics: dict[str, tuple[str, int]] = {}
+    for rel, src in sorted(srcs.items()):
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _attr_name(node.func)
+            if name == "emit" and len(node.args) >= 2:
+                kind_arg = node.args[1]
+                if (isinstance(kind_arg, ast.Constant)
+                        and isinstance(kind_arg.value, str)
+                        and kind_arg.value not in kinds):
+                    findings.append(_mk(
+                        "FL011", rel, node.lineno,
+                        _line_at(src, node.lineno),
+                        f"journal kind {kind_arg.value!r} has no "
+                        f"template in obs/events.py EVENTS",
+                    ))
+            elif name in _METRIC_FACTORIES and node.args:
+                name_arg = node.args[0]
+                if (isinstance(name_arg, ast.Constant)
+                        and isinstance(name_arg.value, str)
+                        and _METRIC_NAME_RE.match(name_arg.value)):
+                    produced_metrics.setdefault(
+                        name_arg.value, (rel, node.lineno)
+                    )
+    for metric, (rel, line) in sorted(produced_metrics.items()):
+        if metric not in registry_docs:
+            findings.append(_mk(
+                "FL011", rel, line, _line_at(srcs.get(rel, ""), line),
+                f"metric {metric!r} is not listed in the "
+                f"docs/observability.md inventory",
+            ))
+    # Consumed direction: what obs_report reads must be produced.  A
+    # literal counts as a consumed metric name when it matches the
+    # naming convention with at least two underscores (separates real
+    # series like serve_cache_size from dict keys like obs_dir) and is
+    # not a journal kind.
+    report_src = aux.get("tools/obs_report.py", "")
+    if report_src:
+        for node in ast.walk(ast.parse(report_src)):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _METRIC_NAME_RE.match(node.value)
+                    and node.value.count("_") >= 2
+                    and node.value not in kinds
+                    and node.value not in produced_metrics):
+                findings.append(_mk(
+                    "FL011", "tools/obs_report.py", node.lineno,
+                    _line_at(report_src, node.lineno),
+                    f"obs_report consumes metric {node.value!r} that "
+                    f"nothing produces",
+                ))
+
+    # FL012 — cfg knob reads vs config.py fields vs docs.
+    fields = _config_fields(aux.get("mx_rcnn_tpu/config.py", ""))
+    seen_knobs: set[tuple[str, str]] = set()
+    for rel, src in sorted(srcs.items()):
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr in _CFG_SECTIONS):
+                continue
+            root = node.value.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if not (isinstance(root, ast.Name)
+                    and ("cfg" in root.id.lower()
+                         or root.id.lower() == "config")):
+                continue
+            section, knob = node.value.attr, node.attr
+            if fields.get(section) is not None and fields[section] and \
+                    knob not in fields[section]:
+                findings.append(_mk(
+                    "FL012", rel, node.lineno,
+                    _line_at(src, node.lineno),
+                    f"cfg.{section}.{knob} is not a field of "
+                    f"{_CFG_CLASS_BY_SECTION[section]} in config.py",
+                ))
+                continue
+            if (section, knob) in seen_knobs:
+                continue
+            seen_knobs.add((section, knob))
+            if f"{section}.{knob}" not in all_docs:
+                findings.append(_mk(
+                    "FL012", rel, node.lineno,
+                    _line_at(src, node.lineno),
+                    f"cfg.{section}.{knob} is read here but documented "
+                    f"in no docs table",
+                ))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def lint_paths(
+    repo_root: str,
+    paths: Optional[Iterable[str]] = None,
+    contracts: bool = True,
+    overlay: Optional[dict] = None,
+) -> list[Finding]:
+    """Concurrency-lint the given repo-relative paths (default: every
+    fleet file) plus, by default, the repo-level contract checks."""
+    findings: list[Finding] = []
+    rels = list(paths) if paths is not None else fleet_files(repo_root)
+    srcs = _read_sources(repo_root, rels, overlay)
+    for rel in rels:
+        if rel in srcs:
+            findings.extend(lint_source(srcs[rel], rel))
+    if contracts:
+        findings.extend(contract_findings(repo_root, overlay))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col))

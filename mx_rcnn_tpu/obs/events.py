@@ -171,6 +171,38 @@ def _fmt_metrics_flush(p: dict) -> str:
     )
 
 
+def _fmt_configured(p: dict) -> str:
+    return (
+        "observability plane up: dir={out_dir} metrics_port="
+        "{metrics_port} spans={spans}"
+    ).format(**p)
+
+
+def _fmt_flight_dump(p: dict) -> str:
+    return "flight recorder dump ({trigger}) -> {path}".format(**p)
+
+
+def _fmt_training_diverged(p: dict) -> str:
+    return (
+        "guardian: training diverged at step {step} ({reason}) after "
+        "{rollbacks} rollback(s) — aborting the run"
+    ).format(**p)
+
+
+def _fmt_lock_order_violation(p: dict) -> str:
+    return (
+        "lockcheck: lock-order cycle closing edge {edge} in thread "
+        "{thread} (held: {held})"
+    ).format(**p)
+
+
+def _fmt_held_lock_blocked_call(p: dict) -> str:
+    return (
+        "lockcheck: blocking call {call} while thread {thread} holds "
+        "{held}"
+    ).format(**p)
+
+
 def _fmt_slo_burn_start(p: dict) -> str:
     return (
         "slo {slo}: burn-rate alert START — {burn_fast:.1f}x over "
@@ -291,8 +323,15 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "gateway_quarantine": (logging.WARNING, _fmt_gateway_quarantine),
     "gateway_reinstate": (logging.INFO, _fmt_gateway_reinstate),
     "gateway_weight_roll": (logging.INFO, _fmt_gateway_weight_roll),
+    # train loop / guardian (terminal)
+    "training_diverged": (logging.ERROR, _fmt_training_diverged),
     # plane-internal
     "metrics_flush": (logging.DEBUG, _fmt_metrics_flush),
+    "configured": (logging.INFO, _fmt_configured),
+    "flight_dump": (logging.WARNING, _fmt_flight_dump),
+    # runtime lock-order sanitizer (mx_rcnn_tpu/analysis/lockcheck.py)
+    "lock_order_violation": (logging.ERROR, _fmt_lock_order_violation),
+    "held_lock_blocked_call": (logging.ERROR, _fmt_held_lock_blocked_call),
 }
 
 

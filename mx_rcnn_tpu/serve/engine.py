@@ -818,7 +818,7 @@ class InferenceEngine:
                 if self._inflight_since is None
                 else round(self._clock() - self._inflight_since, 3)
             )
-        calls, filled = self._occ_calls, self._occ_filled
+            calls, filled = self._occ_calls, self._occ_filled
         return self.health.snapshot(
             queue_depth=self.queue_depth,
             inflight_age_s=inflight_age,
@@ -1016,8 +1016,6 @@ class InferenceEngine:
                 break
             plan = batch[0].plan
             assert plan is not None
-            self._occ_calls += 1
-            self._occ_filled += len(batch)
             obs.histogram(
                 "serve_batch_occupancy",
                 "request slots filled / slots total per device call",
@@ -1028,6 +1026,8 @@ class InferenceEngine:
             )
             start = self._clock()
             with self._lock:
+                self._occ_calls += 1
+                self._occ_filled += len(batch)
                 self._inflight_since = start
                 self._inflight_plan = plan
                 self._inflight_reqs = list(batch)

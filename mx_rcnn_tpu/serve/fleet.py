@@ -48,6 +48,7 @@ import time
 from typing import Callable, Optional, Sequence, Union
 
 from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.analysis import lockcheck
 from mx_rcnn_tpu.serve import result_cache as result_cache_mod
 from mx_rcnn_tpu.serve.engine import (
     DeadlineExceeded,
@@ -225,7 +226,11 @@ class FleetRouter:
         self.default_timeout = default_timeout
         self._clock = clock
         self._lock = threading.Lock()
-        self._swap_lock = threading.Lock()
+        # Serializes weight rolls and rebuild publishes.  Held across
+        # device work BY DESIGN (one roll at a time is the zero-downtime
+        # invariant) — exempted from the lockcheck blocked-call rule,
+        # never from its order rule.
+        self._swap_lock = lockcheck.allow_blocking(threading.Lock())
         # SPARSE rid -> replica map: retire_replica leaves holes,
         # add_replica appends fresh never-reused rids.
         self._replicas: dict[int, _Replica] = {
@@ -906,23 +911,30 @@ class FleetRouter:
                 return  # fleet went away before the build even began
             eng = self._engine_factory(r.rid)
             eng.start()
-            with self._lock:
-                weights, gen = self._weights, self._generation
-            if weights is not None and gen > 0:
-                eng.swap_weights(weights, generation=gen)
-            with self._lock:
-                if self._stopped or self._replicas.get(r.rid) is not r \
-                        or r.state == RETIRING:
-                    pass  # fleet/slot went away mid-build; discard below
-                else:
-                    r.engine = eng
-                    r.state = READY
-                    r.fail_streak = 0
-                    if reinstate:
-                        self._reinstatements += 1
+            # Alignment + publish serialize against swap_weights under
+            # _swap_lock (same _swap_lock -> _lock order): without it a
+            # concurrent roll can advance the generation between our
+            # weights read and the READY publish, putting a stale
+            # replica into rotation that no later roll revisits — it
+            # wasn't live when the roll snapshotted the fleet.
+            with self._swap_lock:
+                with self._lock:
+                    weights, gen = self._weights, self._generation
+                if weights is not None and gen > 0:
+                    eng.swap_weights(weights, generation=gen)
+                with self._lock:
+                    if self._stopped or self._replicas.get(r.rid) is not r \
+                            or r.state == RETIRING:
+                        pass  # fleet/slot went away mid-build; discarded
                     else:
-                        self._added += 1
-                    eng = None
+                        r.engine = eng
+                        r.state = READY
+                        r.fail_streak = 0
+                        if reinstate:
+                            self._reinstatements += 1
+                        else:
+                            self._added += 1
+                        eng = None
             if eng is not None:
                 eng.stop(drain=False)
             elif reinstate:

@@ -39,6 +39,7 @@ import time
 from typing import Callable, Mapping, NamedTuple, Optional, Sequence, Union
 
 from .. import obs
+from ..analysis import lockcheck
 from .engine import (
     DeadlineExceeded,
     EngineUnavailable,
@@ -250,7 +251,10 @@ class GatewayRouter:
         self._cache = result_cache
         self._clock = clock
         self._lock = threading.Lock()
-        self._swap_lock = threading.Lock()
+        # Serializes pod-wide weight rolls and probe re-pushes.  Held
+        # across network pushes BY DESIGN (one roll at a time) —
+        # exempted from the lockcheck blocked-call rule only.
+        self._swap_lock = lockcheck.allow_blocking(threading.Lock())
         self._hosts: dict[str, _Host] = {}
         for hint, addr in items:
             self._hosts[hint] = _Host(hint, addr, client_factory(addr))
@@ -646,31 +650,39 @@ class GatewayRouter:
             reps = max(1, int(fleet.get("replicas", 1)))
             h.reported_load = float(fleet.get("pending", 0)) / reps
             draining = bool(info.get("draining"))
-            behind = (
-                self._last_leaves is not None
-                and h.generation < self._generation
-            )
-            target_gen = self._generation
-            leaves = self._last_leaves
         if draining or not fleet.get("replicas", 0):
             return
         if rebooted:
             log.info(
                 "fabric: host %s rebooted (incarnation %d)", h.host_id, inc
             )
-        if behind and leaves is not None:
-            # Came back on an older generation: align before traffic.
-            try:
-                h.client.swap(leaves, generation=target_gen)
-                with self._lock:
-                    h.generation = target_gen
-            except ServeError as e:
-                log.warning(
-                    "fabric: generation re-push to %s failed: %s",
-                    h.host_id, e,
+        # Alignment + reinstate serialize against swap_weights under
+        # _swap_lock (same _swap_lock -> _lock order): without it a
+        # concurrent roll can advance the pod generation after the
+        # behind-check, and the host re-enters rotation one generation
+        # stale — the roll only pushes to hosts that were live when it
+        # snapshotted the pod, and nothing later revisits this one.
+        with self._swap_lock:
+            with self._lock:
+                behind = (
+                    self._last_leaves is not None
+                    and h.generation < self._generation
                 )
-                return
-        self._reinstate(h)
+                target_gen = self._generation
+                leaves = self._last_leaves
+            if behind and leaves is not None:
+                # Came back on an older generation: align before traffic.
+                try:
+                    h.client.swap(leaves, generation=target_gen)
+                    with self._lock:
+                        h.generation = target_gen
+                except ServeError as e:
+                    log.warning(
+                        "fabric: generation re-push to %s failed: %s",
+                        h.host_id, e,
+                    )
+                    return
+            self._reinstate(h)
 
     # -- views / stats -----------------------------------------------------
 

@@ -86,6 +86,24 @@ _ERROR_TYPES = {
     "DeadlineExceeded": DeadlineExceeded,
 }
 
+# Totality guard, both directions: every typed error serve/engine.py
+# defines must have a wire status (or a future typed error silently
+# degrades to a generic 500 on the way out and a bare ServeError on the
+# way back), and the maps must not name errors that no longer exist.
+# HostUnreachable is defined HERE, not in engine.py — transport-level,
+# raised client-side only, never crosses the wire — so it is excluded
+# by construction.  fleetlint FL010 enforces the same contract
+# statically.
+_WIRE_VOCAB = frozenset(
+    c.__name__ for c in ServeError.__subclasses__()
+    if c.__module__ == ServeError.__module__
+)
+assert _WIRE_VOCAB == frozenset(_ERROR_STATUS) == frozenset(_ERROR_TYPES), (
+    "serve typed-error wire maps are not total over the vocabulary: "
+    f"engine defines {sorted(_WIRE_VOCAB)}, _ERROR_STATUS covers "
+    f"{sorted(_ERROR_STATUS)}, _ERROR_TYPES covers {sorted(_ERROR_TYPES)}"
+)
+
 
 # -- codec --------------------------------------------------------------------
 

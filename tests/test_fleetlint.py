@@ -1,0 +1,620 @@
+"""fleetlint (analysis layer 3) + lockcheck as a tier-1 gate.
+
+Three layers of coverage, mirroring tests/test_tpulint.py:
+
+* rule unit tests — small synthetic sources through
+  ``fleetlint.lint_source`` (FL001–FL005, FL010 raise/except) and seeded
+  ``overlay`` sources through ``fleetlint.contract_findings``
+  (FL010 map totality, FL011, FL012), each with fire AND no-fire cases;
+* baseline ratchet semantics — identical contract to tpulint's
+  (line moves don't churn, edits re-open, counts are budgets);
+* the repo gate — the working tree must be clean against the committed
+  ``fleetlint_baseline.json``, every suppression must carry a human
+  justification, and a seeded lock-order inversion must fail;
+* lockcheck runtime tests — the instrumented locks catch an A→B/B→A
+  inversion deterministically WITHOUT deadlocking, RLock reentrancy is
+  not an ordering event, disabled mode is bit-for-bit
+  ``threading.Lock``, and the fleet/gateway swap paths run sanitized
+  (the regression pin for the races fixed in this PR).
+"""
+
+import textwrap
+import threading
+
+import pytest
+
+from mx_rcnn_tpu.analysis import baseline as baseline_mod
+from mx_rcnn_tpu.analysis import fleetlint, lockcheck
+from mx_rcnn_tpu.serve import GatewayRouter
+
+from test_serve import _fleet, _img
+
+import os
+
+pytestmark = pytest.mark.fleetlint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(ROOT, "fleetlint_baseline.json")
+
+# Snippet path inside the fleet prefixes (and inside serve/ so FL010's
+# raise/except vocabulary applies).
+SNIP = "mx_rcnn_tpu/serve/_snippet.py"
+
+
+def rules_of(src: str, path: str = SNIP) -> list:
+    return [f.rule for f in fleetlint.lint_source(textwrap.dedent(src), path)]
+
+
+# ---------------------------------------------------------------------------
+# concurrency rules, synthetic sources
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyRules:
+    def test_out_of_scope_path_is_skipped(self):
+        src = "import threading\nlock = threading.Lock()\nlock.acquire()\n"
+        assert fleetlint.lint_source(src, "mx_rcnn_tpu/models/resnet.py") == []
+
+    def test_fl001_fires_on_inverted_with_nesting(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert rules.count("FL001") == 2  # both edges sit on the cycle
+
+    def test_fl001_fires_via_one_level_call_closure(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def one(self):
+                    with self._a_lock:
+                        self.helper()
+
+                def helper(self):
+                    with self._b_lock:
+                        pass
+
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert "FL001" in rules
+
+    def test_fl001_quiet_on_consistent_order(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """)
+        assert "FL001" not in rules
+
+    def test_fl002_fires_on_bare_acquire(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    self._lock.acquire()
+                    self.n = 1
+                    self._lock.release()
+        """)
+        assert "FL002" in rules
+
+    def test_fl002_quiet_with_try_finally(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def good(self):
+                    self._lock.acquire()
+                    try:
+                        self.n = 1
+                    finally:
+                        self._lock.release()
+        """)
+        assert "FL002" not in rules
+
+    def test_fl003_fires_on_undaemonized_unjoined_thread(self):
+        rules = rules_of("""
+            import threading
+
+            def spawn(run):
+                t = threading.Thread(target=run)
+                t.start()
+        """)
+        assert "FL003" in rules
+
+    def test_fl003_quiet_with_daemon_or_join(self):
+        assert "FL003" not in rules_of("""
+            import threading
+
+            def spawn(run):
+                t = threading.Thread(target=run, daemon=True)
+                t.start()
+        """)
+        assert "FL003" not in rules_of("""
+            import threading
+
+            def spawn(run):
+                t = threading.Thread(target=run)
+                t.start()
+                t.join()
+        """)
+
+    def test_fl004_fires_on_unlocked_thread_target_write(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True
+                    )
+
+                def _run(self):
+                    self.counter = 1
+
+                def read(self):
+                    return self.counter
+        """)
+        assert "FL004" in rules
+
+    def test_fl004_quiet_when_write_is_locked(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(
+                        target=self._run, daemon=True
+                    )
+
+                def _run(self):
+                    with self._lock:
+                        self.counter = 1
+
+                def read(self):
+                    return self.counter
+        """)
+        assert "FL004" not in rules
+
+    def test_fl005_fires_on_blocking_get_and_urlopen_under_lock(self):
+        rules = rules_of("""
+            import threading
+            from urllib.request import urlopen
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = None
+
+                def bad_get(self):
+                    with self._lock:
+                        return self.q.get()
+
+                def bad_net(self):
+                    with self._lock:
+                        return urlopen("http://x/")
+        """)
+        assert rules.count("FL005") == 2
+
+    def test_fl005_quiet_with_timeout_and_condition_wait(self):
+        rules = rules_of("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self.q = None
+
+                def ok_get(self):
+                    with self._cv:
+                        return self.q.get(timeout=1.0)
+
+                def waiter(self):
+                    with self._cv:
+                        self._cv.wait()
+        """)
+        assert "FL005" not in rules
+
+    def test_fl010_fires_on_untyped_raise_in_serve(self):
+        assert "FL010" in rules_of("""
+            def f():
+                raise FlBogusError("nope")
+        """)
+        assert "FL010" not in rules_of("""
+            def f():
+                raise Overloaded("queue full")
+        """)
+        # Same source outside serve/: vocabulary does not apply.
+        assert "FL010" not in rules_of(
+            "def f():\n    raise FlBogusError('x')\n",
+            path="tools/_snippet.py",
+        )
+
+
+# ---------------------------------------------------------------------------
+# contract rules, seeded via overlay
+# ---------------------------------------------------------------------------
+
+
+class TestContractRules:
+    def test_repo_contracts_are_clean(self):
+        assert fleetlint.contract_findings(ROOT) == []
+
+    def test_fl011_seeded_unregistered_journal_kind(self):
+        overlay = {
+            "mx_rcnn_tpu/serve/_seed.py": (
+                "from mx_rcnn_tpu import obs\n"
+                'obs.emit("serve", "fl_test_bogus_kind", {})\n'
+            )
+        }
+        found = fleetlint.contract_findings(ROOT, overlay=overlay)
+        assert any(
+            f.rule == "FL011" and "fl_test_bogus_kind" in f.message
+            for f in found
+        )
+
+    def test_fl011_seeded_unregistered_metric(self):
+        overlay = {
+            "mx_rcnn_tpu/serve/_seed.py": (
+                "from mx_rcnn_tpu import obs\n"
+                'M = obs.counter("serve_fl_bogus_total", "seeded")\n'
+            )
+        }
+        found = fleetlint.contract_findings(ROOT, overlay=overlay)
+        assert any(
+            f.rule == "FL011" and "serve_fl_bogus_total" in f.message
+            for f in found
+        )
+
+    def test_fl010_seeded_error_breaks_map_totality(self):
+        with open(os.path.join(ROOT, "mx_rcnn_tpu/serve/engine.py")) as f:
+            engine_src = f.read()
+        overlay = {
+            "mx_rcnn_tpu/serve/engine.py": engine_src
+            + "\n\nclass FlSeededError(ServeError):\n    pass\n"
+        }
+        found = fleetlint.contract_findings(ROOT, overlay=overlay)
+        assert any(
+            f.rule == "FL010" and "FlSeededError" in f.message
+            for f in found
+        )
+
+    def test_fl012_seeded_unknown_knob(self):
+        overlay = {
+            "mx_rcnn_tpu/serve/_seed.py": (
+                "def f(cfg):\n    return cfg.serve.fl_bogus_knob\n"
+            )
+        }
+        found = fleetlint.contract_findings(ROOT, overlay=overlay)
+        assert any(
+            f.rule == "FL012" and "fl_bogus_knob" in f.message
+            for f in found
+        )
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet semantics (same contract as tpulint's)
+# ---------------------------------------------------------------------------
+
+
+def _finding(rule="FL002", path=SNIP, line=10,
+             snippet="self._lock.acquire()"):
+    return fleetlint.Finding(rule=rule, path=path, line=line, col=4,
+                             snippet=snippet, message=fleetlint.RULES[rule])
+
+
+class TestBaseline:
+    def test_roundtrip_suppresses(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        f = _finding()
+        baseline_mod.write_baseline(p, [f])
+        b = baseline_mod.load_baseline(p)
+        assert baseline_mod.new_findings([f], b) == []
+
+    def test_line_move_does_not_reopen(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        baseline_mod.write_baseline(p, [_finding(line=10)])
+        b = baseline_mod.load_baseline(p)
+        assert baseline_mod.new_findings([_finding(line=99)], b) == []
+
+    def test_extra_occurrence_is_new(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        baseline_mod.write_baseline(p, [_finding()])
+        b = baseline_mod.load_baseline(p)
+        new = baseline_mod.new_findings(
+            [_finding(line=10), _finding(line=20)], b
+        )
+        assert len(new) == 1 and new[0].line == 20
+
+    def test_edited_line_reopens(self, tmp_path):
+        p = str(tmp_path / "b.json")
+        baseline_mod.write_baseline(p, [_finding()])
+        b = baseline_mod.load_baseline(p)
+        edited = _finding(snippet="self._other_lock.acquire()")
+        assert baseline_mod.new_findings([edited], b) == [edited]
+
+    def test_missing_baseline_means_all_new(self, tmp_path):
+        b = baseline_mod.load_baseline(str(tmp_path / "absent.json"))
+        f = _finding()
+        assert baseline_mod.new_findings([f], b) == [f]
+
+    def test_version_mismatch_rejected(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text('{"version": 99, "suppressions": {}}')
+        with pytest.raises(ValueError):
+            baseline_mod.load_baseline(str(p))
+
+
+# ---------------------------------------------------------------------------
+# the repo gate
+# ---------------------------------------------------------------------------
+
+
+_SEEDED_INVERSION = """
+
+class _FlSeededInversion:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+
+class TestRepoGate:
+    def test_working_tree_is_clean_against_baseline(self):
+        findings = fleetlint.lint_paths(ROOT)
+        b = baseline_mod.load_baseline(BASELINE_PATH)
+        new = baseline_mod.new_findings(findings, b)
+        assert new == [], "\n".join(f.format() for f in new)
+
+    def test_every_suppression_carries_a_justification(self):
+        b = baseline_mod.load_baseline(BASELINE_PATH)
+        assert b["suppressions"], "gate must be exercising a real baseline"
+        for fp, entry in b["suppressions"].items():
+            assert entry.get("comment", "").strip(), (
+                f"baseline entry {fp} ({entry.get('path')}) has no "
+                f"justification comment — a suppression without a why "
+                f"does not survive review"
+            )
+
+    def test_seeded_inversion_fails_the_gate(self):
+        rel = "mx_rcnn_tpu/serve/fleet.py"
+        with open(os.path.join(ROOT, rel)) as f:
+            src = f.read()
+        findings = fleetlint.lint_source(src + _SEEDED_INVERSION, rel)
+        b = baseline_mod.load_baseline(BASELINE_PATH)
+        new = baseline_mod.new_findings(findings, b)
+        assert any(f.rule == "FL001" for f in new)
+
+    def test_committed_report_matches_reality(self):
+        report_path = os.path.join(ROOT, "artifacts/fleetlint_report.json")
+        assert os.path.exists(report_path), (
+            "run `python tools/fleetlint.py --check` and commit the report"
+        )
+        import json
+
+        with open(report_path) as f:
+            report = json.load(f)
+        assert report["ok"] is True
+        assert report["static"]["new"] == []
+
+
+# ---------------------------------------------------------------------------
+# lockcheck: the runtime twin
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sanitizer():
+    was_enabled = lockcheck.enabled()
+    lockcheck.install()
+    lockcheck.reset()
+    yield lockcheck
+    lockcheck.reset()
+    if not was_enabled:
+        lockcheck.uninstall()
+
+
+class TestLockcheck:
+    def test_disabled_mode_is_the_real_lock(self):
+        if lockcheck.enabled():
+            pytest.skip("sanitizer active via MX_RCNN_LOCKCHECK")
+        # Bit-for-bit: the names ARE the C originals, not wrappers.
+        assert threading.Lock is lockcheck._REAL_LOCK
+        assert threading.RLock is lockcheck._REAL_RLOCK
+
+    def test_inversion_raises_without_deadlocking(self, sanitizer):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockcheck.LockOrderViolation):
+            with b:
+                with a:
+                    pass
+        assert sanitizer.violation_count() == 1
+        # The raise released the inner probe: nothing is left held.
+        assert not a.locked() and not b.locked()
+
+    def test_cross_thread_inversion_is_deterministic(self, sanitizer):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def establish():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=establish, daemon=True)
+        t.start()
+        t.join()
+        # No contention, no timing: the graph alone convicts the
+        # opposite nesting on the main thread.
+        with pytest.raises(lockcheck.LockOrderViolation):
+            with b:
+                with a:
+                    pass
+
+    def test_rlock_reentrancy_is_not_an_ordering_event(self, sanitizer):
+        r = threading.RLock()
+        with r:
+            with r:
+                with r:
+                    pass
+        assert sanitizer.order_graph() == {}
+        assert sanitizer.violation_count() == 0
+
+    def test_blocking_region_under_held_lock(self, sanitizer):
+        lk = threading.Lock()
+        with lk:
+            with pytest.raises(lockcheck.HeldLockBlockedCall):
+                with lockcheck.blocking_region("device_sync"):
+                    pass
+        assert sanitizer.violation_count() == 1
+
+    def test_allow_blocking_exempts_one_lock(self, sanitizer):
+        lk = lockcheck.allow_blocking(threading.Lock())
+        with lk:
+            with lockcheck.blocking_region("device_sync"):
+                pass
+        assert sanitizer.violation_count() == 0
+        # ... but never from order checking: exempt locks still edge.
+        other = threading.Lock()
+        with lk:
+            with other:
+                pass
+        with pytest.raises(lockcheck.LockOrderViolation):
+            with other:
+                with lk:
+                    pass
+
+    def test_allow_blocking_is_noop_on_real_locks(self):
+        raw = lockcheck._REAL_LOCK()
+        assert lockcheck.allow_blocking(raw) is raw
+
+
+# ---------------------------------------------------------------------------
+# regression pins: the swap-path races fixed in this PR
+# ---------------------------------------------------------------------------
+
+
+class _StubHost:
+    """Minimal RpcClient stand-in for the gateway regression test."""
+
+    def __init__(self, host_id):
+        self.host_id = host_id
+        self.generation = 0
+        self.incarnation = 1
+        self.swap_calls = []
+
+    def stats(self, timeout_s=5.0):
+        return {
+            "ok": True, "host_id": self.host_id,
+            "incarnation": self.incarnation,
+            "generation": self.generation, "draining": False,
+            "fleet": {"replicas": 2, "pending": 0},
+        }
+
+    def infer(self, image, *, deadline_s=None, trace_id=None):
+        return {"host_id": self.host_id, "generation": self.generation}
+
+    def swap(self, leaves, generation=None, timeout_s=120.0):
+        self.swap_calls.append((len(leaves), generation))
+        self.generation = generation
+        return generation
+
+
+class TestSwapRaceRegressions:
+    def test_fleet_roll_runs_sanitized(self, sanitizer):
+        fleet, _runners = _fleet(3)
+        with fleet:
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(6)]
+            assert len([r.result(10) for r in reqs]) == 6
+            assert fleet.swap_weights({"w": 1}) == 1
+            reqs = [fleet.submit(_img(8, 8), timeout=10) for _ in range(3)]
+            assert len([r.result(10) for r in reqs]) == 3
+        assert sanitizer.violation_count() == 0
+        # The pre-fix nesting (_rebuild publishing under _lock while a
+        # roll holds _swap_lock) is an inversion of the order the fixed
+        # code just established — the sanitizer must convict it.
+        with pytest.raises(lockcheck.LockOrderViolation):
+            with fleet._lock:
+                with fleet._swap_lock:
+                    pass
+
+    def test_gateway_probe_vs_roll_runs_sanitized(self, sanitizer):
+        clients = {"a:1": _StubHost("hostA"), "b:1": _StubHost("hostB")}
+        gw = GatewayRouter(
+            sorted(clients), client_factory=lambda addr: clients[addr],
+            probe_interval_s=30.0,
+        )
+        gw.start()
+        try:
+            assert gw.swap_weights(leaves=[b"w0"]) == 1
+            # A host comes back stale: the probe's re-push + reinstate
+            # must serialize with rolls under _swap_lock.
+            h = next(iter(gw._hosts.values()))
+            h.client.generation = 0
+            gw._probe_host(h)
+            assert h.client.generation == 1
+            assert h.client.swap_calls[-1] == (1, 1)
+            assert sanitizer.violation_count() == 0
+            with pytest.raises(lockcheck.LockOrderViolation):
+                with gw._lock:
+                    with gw._swap_lock:
+                        pass
+        finally:
+            gw.stop()

@@ -118,7 +118,7 @@ Usage:
                                     |host_kill|host_partition
                                     |cross_host_swap]
                         [--steps 12] [--workdir DIR] [--keep] [--timeout 900]
-                        [--scenario-timeout SECONDS]
+                        [--scenario-timeout SECONDS] [--lockcheck auto|on|off]
 
 ``--scenario`` also takes a comma-separated list (e.g.
 ``--scenario data_worker_kill,cache_corrupt``) — scenarios share the
@@ -128,6 +128,12 @@ Every scenario runs under a per-scenario wall-clock budget
 (``--scenario-timeout``, default 1.5x ``--timeout``); on expiry the
 orphan reaper SIGKILLs every live child so one wedged scenario cannot
 hang the harness past its budget.
+
+The fleet/fabric scenarios additionally run their children under the
+runtime lock-order sanitizer (``--lockcheck auto``, the default, sets
+``MX_RCNN_LOCKCHECK=1`` — see mx_rcnn_tpu/analysis/lockcheck.py): a
+lock-order inversion or a blocking call under a held lock raises in the
+child AND lands in the obs journal, and either fails the scenario.
 
 Prints one JSON summary line on stdout; exits non-zero if any scenario
 fails.  (`--child*` / `--compare` are internal subprocess entry modes.)
@@ -454,8 +460,15 @@ def child_swap_main() -> int:
     generation it reports — a half-swapped tree would match neither."""
     _fleet_cpu(4)
     import numpy as np
+    from mx_rcnn_tpu import obs
     from mx_rcnn_tpu.config import get_config
     from mx_rcnn_tpu.serve import build_fleet
+
+    obs_dir = os.environ.get("MX_RCNN_OBS_DIR")
+    if obs_dir:
+        # Journaled so the parent's lock-sanitizer sweep sees swap-path
+        # violations even from threads that swallow exceptions.
+        obs.configure(obs_dir)
 
     cfg = get_config(CONFIG)
     v0 = _init_variables(cfg, seed=0)
@@ -1639,6 +1652,33 @@ def scenario_eval_corrupt(root: str, steps: int, timeout: float) -> dict:
     return {"quarantined": sorted(quarantined), "dump_images": len(dump)}
 
 
+# Journal kinds written by the runtime lock sanitizer
+# (mx_rcnn_tpu/analysis/lockcheck.py).  The in-process raise is the
+# primary signal — a child that trips dies nonzero — but a violation on
+# a thread whose exceptions get swallowed (supervisor loops, probe
+# loops) still reaches the journal, and the scenario must fail on it.
+SANITIZER_KINDS = {"lock_order_violation", "held_lock_blocked_call"}
+
+
+def _assert_no_sanitizer_reports(wd: str) -> None:
+    """Fail if any journal under this scenario's workdir carries a
+    lockcheck report.  No-op when the sanitizer was not enabled."""
+    if os.environ.get("MX_RCNN_LOCKCHECK") != "1":
+        return
+    for path in glob.glob(
+        os.path.join(wd, "**", "journal.jsonl"), recursive=True
+    ):
+        with open(path) as f:
+            for ln in f:
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue
+                assert rec.get("kind") not in SANITIZER_KINDS, (
+                    f"lock sanitizer report in {path}: {rec}"
+                )
+
+
 def _json_child(root: str, name: str, flag: str, timeout: float,
                 env: Optional[dict] = None) -> dict:
     """Run a self-asserting child mode; return its JSON stdout line."""
@@ -1656,6 +1696,7 @@ def _json_child(root: str, name: str, flag: str, timeout: float,
     )
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
     assert lines, f"{name} child printed no JSON:\n{out.stdout}"
+    _assert_no_sanitizer_reports(wd)
     return json.loads(lines[-1])
 
 
@@ -1727,7 +1768,9 @@ def scenario_replica_wedge(root: str, steps: int, timeout: float) -> dict:
 
 
 def scenario_swap_under_load(root: str, steps: int, timeout: float) -> dict:
-    r = _json_child(root, "swap_under_load", "--child-swap", timeout)
+    obs_dir = os.path.join(root, "swap_under_load", "obs")
+    r = _json_child(root, "swap_under_load", "--child-swap", timeout,
+                    env={"MX_RCNN_OBS_DIR": obs_dir})
     assert not r["mismatched"] and not r["errors"], r
     assert r["generations_seen"] == [0, r["swap_generation"]], r
     return r
@@ -1762,6 +1805,7 @@ def scenario_fleet_drain(root: str, steps: int, timeout: float) -> dict:
     assert lines, f"drain child printed no JSON\n{child.log_tail()}"
     r = json.loads(lines[-1])
     assert r["accepted"] > 0 and r["failed"] == 0 and r["drained_clean"], r
+    _assert_no_sanitizer_reports(wd)
     return r
 
 
@@ -1860,6 +1904,18 @@ NEEDS_BASELINE = {
     "data_service_dead",
 }
 
+# Scenarios that exercise the threaded serving plane: `--lockcheck auto`
+# (the default) runs these with MX_RCNN_LOCKCHECK=1 so every child —
+# including the fabric's per-host subprocesses, which inherit the
+# environment — gets instrumented locks.  The sanitizer is deliberately
+# NOT defaulted on for the training scenarios: their children assert
+# bitwise-exact resume, and instrumentation has no business there.
+LOCKCHECK_SCENARIOS = {
+    "overload", "hang", "replica_kill", "replica_wedge",
+    "swap_under_load", "fleet_drain", "fleet_scale",
+    "host_kill", "host_partition", "cross_host_swap",
+}
+
 
 def main(argv=None) -> int:
     if argv is None:
@@ -1909,6 +1965,12 @@ def main(argv=None) -> int:
                    help="hard per-scenario budget; on expiry every live "
                         "child is SIGKILLed and the scenario is marked "
                         "failed (default: 1.5 x --timeout)")
+    p.add_argument("--lockcheck", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="run children under the runtime lock-order "
+                        "sanitizer (MX_RCNN_LOCKCHECK=1): 'auto' enables "
+                        "it for the fleet/fabric scenarios, 'on'/'off' "
+                        "force it everywhere/nowhere")
     args = p.parse_args(argv)
     scenario_timeout = args.scenario_timeout or 1.5 * args.timeout
 
@@ -1930,6 +1992,16 @@ def main(argv=None) -> int:
     failed = []
     for name in names:
         t0 = time.monotonic()
+        # Env (not argv) so it reaches every process a scenario spawns,
+        # transitively: _json_child children, Child-managed servers, and
+        # the fabric hosts they fork in turn.
+        lockcheck_on = args.lockcheck == "on" or (
+            args.lockcheck == "auto" and name in LOCKCHECK_SCENARIOS
+        )
+        if lockcheck_on:
+            os.environ["MX_RCNN_LOCKCHECK"] = "1"
+        else:
+            os.environ.pop("MX_RCNN_LOCKCHECK", None)
         # Hard backstop above the per-child timeout: a scenario whose
         # orchestration half wedges (not just the child) gets its entire
         # process tree reaped rather than hanging the suite.
@@ -1961,6 +2033,7 @@ def main(argv=None) -> int:
         print(f"[chaos] {name}: {r}", file=sys.stderr)
         if name == "baseline" and not r["ok"]:
             break  # nothing to compare against
+    os.environ.pop("MX_RCNN_LOCKCHECK", None)
     print(json.dumps({"root": root, "steps": args.steps, "results": results}))
     if not args.keep and not failed:
         shutil.rmtree(root, ignore_errors=True)

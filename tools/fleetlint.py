@@ -1,0 +1,123 @@
+"""fleetlint CLI: concurrency + contract lint for the serving plane.
+
+Runs the static layer of mx_rcnn_tpu/analysis/fleetlint.py — the
+lock-order/threading rules (FL001–FL005) over ``serve/ obs/ ctrl/ data/
+tools/`` plus the repo-level contract rules (FL010 typed-error
+vocabulary + RPC status-map totality, FL011 journal-kind/metric
+registry, FL012 cfg-knob docs) — diffs the findings against the
+committed baseline (``fleetlint_baseline.json``) and writes
+``artifacts/fleetlint_report.json``.  Only NEW findings fail.
+
+The runtime twin (the lock-order sanitizer) is
+mx_rcnn_tpu/analysis/lockcheck.py, activated with MX_RCNN_LOCKCHECK=1 —
+``tools/chaos.py --lockcheck`` threads it into every fleet scenario.
+
+Usage:
+  python tools/fleetlint.py --check              # CI gate: exit 1 on any
+                                                 # new finding
+  python tools/fleetlint.py                      # report only, exit 0
+  python tools/fleetlint.py --no-contracts [paths...]  # concurrency
+                                                 # rules only
+  python tools/fleetlint.py --write-baseline     # refreeze (review the
+                                                 # diff!)
+
+Pure AST — no jax import, no accelerator, sub-second.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on new findings")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip FL010-FL012 (concurrency rules only)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings as the baseline")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO_ROOT,
+                                         "fleetlint_baseline.json"))
+    ap.add_argument("--out",
+                    default=os.path.join(REPO_ROOT, "artifacts",
+                                         "fleetlint_report.json"))
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files for the concurrency rules "
+                         "(default: all fleet modules)")
+    args = ap.parse_args(argv)
+
+    # Pure-AST import path: mx_rcnn_tpu.analysis.fleetlint does not pull
+    # in jax, so the linter stays fast even on a machine with no
+    # accelerator stack at all.
+    from mx_rcnn_tpu.analysis.baseline import (
+        collect_counts,
+        load_baseline,
+        new_findings,
+        write_baseline,
+    )
+    from mx_rcnn_tpu.analysis.fleetlint import (
+        RULES,
+        fleet_files,
+        lint_paths,
+    )
+
+    findings = lint_paths(
+        REPO_ROOT, args.paths or None,
+        contracts=not args.no_contracts,
+    )
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"baseline frozen: {len(findings)} findings -> "
+              f"{args.baseline}", file=sys.stderr)
+    baseline = load_baseline(args.baseline)
+    new = new_findings(findings, baseline)
+
+    report = {
+        "rules": RULES,
+        "static": {
+            "files_scanned": len(args.paths or fleet_files(REPO_ROOT)),
+            "total_findings": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "snippet": f.snippet, "fingerprint": f.fingerprint()}
+                for f in new
+            ],
+            "per_rule": {
+                rule: sum(1 for f in findings if f.rule == rule)
+                for rule in sorted(RULES)
+            },
+            "fingerprints": collect_counts(findings),
+        },
+        "ok": not new,
+    }
+    for f in new:
+        print(f"NEW {f.format()}", file=sys.stderr)
+    if new:
+        print(f"fleetlint: {len(new)} new finding(s) beyond baseline",
+              file=sys.stderr)
+    else:
+        print(f"fleetlint: clean ({len(findings)} baselined finding(s))",
+              file=sys.stderr)
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps({"metric": "fleetlint_ok", "value": bool(report["ok"])}))
+    if args.check and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
