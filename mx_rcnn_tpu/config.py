@@ -477,6 +477,43 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class DeployConfig:
+    """Continuous deployment (ctrl/deploy.py): shadow canaries,
+    parity-gated promotion, burn-triggered automatic rollback.  All
+    knobs read as ``cfg.ctrl.deploy.*`` (docs/deployment.md has the
+    full table)."""
+
+    # Master switch: serving entrypoints that honour it (tools/soak.py
+    # --deploy, tools/deploy_watch.py) run a Deployer next to the fleet.
+    enabled: bool = False
+    # Seconds between checkpoint-directory scans.
+    poll_s: float = 2.0
+    # Fraction of accepted live submissions mirrored to the shadow
+    # replica (deterministic every-Nth sampling, N = round(1/rate)).
+    mirror_rate: float = 0.25
+    # Minimum mirrored live/shadow pairs before the gate may rule.
+    min_mirrored: int = 8
+    # Maximum seconds a candidate may sit in shadow before the gate
+    # rules on whatever evidence it has.
+    shadow_window_s: float = 30.0
+    # Golden-set mAP gate: allowed absolute mAP regression of the
+    # shadow vs the live generation on the golden set.
+    map_drop: float = 0.005
+    # Shadow-scoped SLO (dedicated SLOEngine over the shadow's private
+    # metrics window): targets + burn windows scaled to the shadow
+    # phase, not the live 5min/1h pair.
+    availability_target: float = 0.95
+    latency_target: float = 0.95
+    latency_threshold_s: float = 30.0
+    burn_fast_s: float = 5.0
+    burn_slow_s: float = 15.0
+    burn_factor: float = 2.0
+    # Post-promote watch: a live burn alert inside this window triggers
+    # automatic rollback to the previous generation's retained leaves.
+    watch_window_s: float = 60.0
+
+
+@dataclass(frozen=True)
 class CtrlConfig:
     """Closed-loop control plane (mx_rcnn_tpu/ctrl/): SLO burn-rate
     alerting and the SLO-driven autoscaler.  Host-side by construction —
@@ -516,6 +553,9 @@ class CtrlConfig:
     burn_fast_s: float = 300.0
     burn_slow_s: float = 3600.0
     burn_factor: float = 2.0
+    # Continuous deployment (ctrl/deploy.py): shadow canary + promote +
+    # rollback knobs, read as cfg.ctrl.deploy.* (docs/deployment.md).
+    deploy: DeployConfig = field(default_factory=DeployConfig)
 
 
 @dataclass(frozen=True)

@@ -11,6 +11,11 @@ stack; this package *acts* on what it sees:
   queue-depth / shed-rate / windowed-p99 pressure into
   ``FleetRouter.add_replica()`` / ``retire_replica()`` calls, with
   scale-down hysteresis mirroring ``serve/degrade.HysteresisPlanner``.
+* ``ctrl/deploy.py`` — a :class:`Deployer` that watches the checkpoint
+  directory, stages candidates as shadow canaries behind a
+  parity + shadow-SLO gate, promotes through the one-at-a-time weight
+  roll, and rolls back automatically on a post-promote burn alert
+  (docs/deployment.md).
 
 Everything here is host-side control logic: tpulint's TPU007 rule bans
 ``mx_rcnn_tpu.ctrl`` imports from jit-traced modules, exactly as it
@@ -23,6 +28,11 @@ from mx_rcnn_tpu.ctrl.autoscale import (
     ScalePolicy,
     ScaleSignals,
     desired_action,
+)
+from mx_rcnn_tpu.ctrl.deploy import (
+    Deployer,
+    ShadowVerdict,
+    build_deployer,
 )
 from mx_rcnn_tpu.ctrl.slo import (
     SLO,
@@ -58,4 +68,7 @@ __all__ = [
     "ScaleSignals",
     "desired_action",
     "build_controller",
+    "Deployer",
+    "ShadowVerdict",
+    "build_deployer",
 ]

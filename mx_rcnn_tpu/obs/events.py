@@ -224,6 +224,54 @@ def _fmt_fleet_scale_up(p: dict) -> str:
     ).format(**p)
 
 
+def _fmt_deploy_candidate(p: dict) -> str:
+    return (
+        "deploy: candidate step {step} manifest "
+        "{status} ({reason})"
+    ).format(status="ok" if p.get("valid") else "REJECTED", **p)
+
+
+def _fmt_deploy_shadow_start(p: dict) -> str:
+    return (
+        "deploy: step {step} entering shadow as generation {generation} "
+        "(mirror rate {mirror_rate})"
+    ).format(**p)
+
+
+def _fmt_deploy_shadow_verdict(p: dict) -> str:
+    return (
+        "deploy: step {step} shadow verdict {verdict} ({reason}) — "
+        "{mirrored} mirrored, {mismatched}/{compared} bitwise mismatches, "
+        "{level_mismatch} level-mismatched, mAP live={map_live} "
+        "shadow={map_shadow}, shadow SLO {slo}"
+    ).format(slo="held" if p.get("slo_ok") else "VIOLATED", **p)
+
+
+def _fmt_deploy_promote(p: dict) -> str:
+    return (
+        "deploy: step {step} PROMOTED generation {from_generation} -> "
+        "{generation}; watching burn for {watch_window_s:.0f}s"
+    ).format(**p)
+
+
+def _fmt_deploy_reject(p: dict) -> str:
+    return "deploy: step {step} rejected ({reason})".format(**p)
+
+
+def _fmt_deploy_rollback(p: dict) -> str:
+    return (
+        "deploy: ROLLBACK {from_generation} -> {to_generation} "
+        "(restores generation {restored_generation} weights; "
+        "burn on slo {slo})"
+    ).format(**p)
+
+
+def _fmt_deploy_resume(p: dict) -> str:
+    return (
+        "deploy: journal recovery for step {step}: {action}"
+    ).format(**p)
+
+
 def _fmt_fleet_scale_down(p: dict) -> str:
     return (
         "autoscaler: scale down {size} -> {target} after {dwell} "
@@ -316,6 +364,14 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "slo_burn_stop": (logging.INFO, _fmt_slo_burn_stop),
     "fleet_scale_up": (logging.WARNING, _fmt_fleet_scale_up),
     "fleet_scale_down": (logging.INFO, _fmt_fleet_scale_down),
+    # continuous deployment (ctrl/deploy.py)
+    "deploy_candidate": (logging.INFO, _fmt_deploy_candidate),
+    "deploy_shadow_start": (logging.INFO, _fmt_deploy_shadow_start),
+    "deploy_shadow_verdict": (logging.INFO, _fmt_deploy_shadow_verdict),
+    "deploy_promote": (logging.WARNING, _fmt_deploy_promote),
+    "deploy_reject": (logging.WARNING, _fmt_deploy_reject),
+    "deploy_rollback": (logging.ERROR, _fmt_deploy_rollback),
+    "deploy_resume": (logging.WARNING, _fmt_deploy_resume),
     # cross-host fabric (serve/gossip.py, serve/gateway.py)
     "peer_suspect": (logging.WARNING, _fmt_peer_suspect),
     "peer_dead": (logging.ERROR, _fmt_peer_dead),

@@ -181,6 +181,30 @@ class _Replica:
         self.rebuild_thread: Optional[threading.Thread] = None
 
 
+class _Mirror:
+    """Shadow-canary mirroring hook (ctrl/deploy.py): every Nth accepted
+    submission's image is handed to ``fn`` out of band.  The hook only
+    ever sees a copy of the input, never the caller's request result
+    path, so shadow responses cannot reach callers by construction."""
+
+    __slots__ = ("fn", "every", "fired", "_n", "_lock")
+
+    def __init__(self, fn: Callable, rate: float) -> None:
+        self.fn = fn
+        self.every = max(1, int(round(1.0 / max(float(rate), 1e-6))))
+        self.fired = 0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def sample(self) -> bool:
+        with self._lock:
+            self._n += 1
+            if self._n % self.every:
+                return False
+            self.fired += 1
+            return True
+
+
 class FleetRouter:
     """Router + supervisor over N replica engines.
 
@@ -207,6 +231,7 @@ class FleetRouter:
         supervisor_poll: float = 0.25,
         default_timeout: Optional[float] = None,
         result_cache=None,
+        initial_weights=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if n_replicas < 1:
@@ -237,7 +262,14 @@ class FleetRouter:
             rid: _Replica(rid) for rid in range(n_replicas)
         }
         self._next_rid = n_replicas
-        self._weights = None       # last swapped tree (rebuild alignment)
+        # Current tree (rebuild alignment; seeded by build_fleet so the
+        # generation-0 tree is known) + the PREVIOUS generation's tree —
+        # depth-2 history so deploy rollback (ctrl/deploy.py) is a local
+        # re-push, never a checkpoint reload.
+        self._weights = initial_weights
+        self._weights_prev: Optional[tuple[int, object]] = None
+        # Shadow mirror hook (ctrl/deploy.py installs one per canary).
+        self._mirror: Optional[_Mirror] = None
         self._generation = 0
         self._pending = 0
         self._started = False
@@ -414,6 +446,12 @@ class FleetRouter:
         with self._lock:
             self._submitted += 1
             self._pending += 1
+        mir = self._mirror
+        if mir is not None and mir.sample():
+            try:
+                mir.fn(image, freq)
+            except Exception:  # noqa: BLE001 - mirror must not hurt callers
+                log.exception("fleet: shadow mirror hook failed")
         threading.Thread(
             target=self._watch, args=(freq,),
             name="fleet-watch", daemon=True,
@@ -491,6 +529,10 @@ class FleetRouter:
                         f"generation must advance: {target} <= "
                         f"{self._generation}"
                     )
+                if self._weights is not None:
+                    # Depth-2 history: the outgoing generation's tree is
+                    # retained so rollback is a local re-push.
+                    self._weights_prev = (self._generation, self._weights)
                 self._weights = variables
                 self._generation = target
                 live = [
@@ -528,6 +570,30 @@ class FleetRouter:
     def generation(self) -> int:
         with self._lock:
             return self._generation
+
+    def current_weights(self) -> tuple[int, object]:
+        """(generation, variables) currently published (variables is
+        None when the fleet was built without ``initial_weights`` and
+        never swapped)."""
+        with self._lock:
+            return self._generation, self._weights
+
+    def previous_weights(self) -> Optional[tuple[int, object]]:
+        """(generation, variables) of the generation BEFORE the current
+        one, or None when no history exists yet — the rollback source
+        for ctrl/deploy.py (re-published under a new, higher number)."""
+        with self._lock:
+            return self._weights_prev
+
+    def set_mirror(self, fn: Callable, rate: float) -> None:
+        """Install the shadow mirror: ``fn(image, freq)`` runs for
+        roughly ``rate`` of accepted submissions right after placement,
+        off the caller's result path.  One mirror at a time — installing
+        replaces the previous hook."""
+        self._mirror = _Mirror(fn, rate)
+
+    def clear_mirror(self) -> None:
+        self._mirror = None
 
     @property
     def pending(self) -> int:
@@ -1000,6 +1066,19 @@ class FleetRouter:
             raise TimeoutError(f"replica {rid} not ready in {timeout}s")
         return rid
 
+    def build_spare_engine(self):
+        """An out-of-rotation engine from the fleet's own factory on a
+        fresh, never-reused rid — the deploy shadow slot
+        (ctrl/deploy.py).  The engine never enters the replica map, so
+        routing, supervision and weight rolls cannot see it; the caller
+        owns its lifecycle (start/swap/stop)."""
+        with self._lock:
+            if self._stopped or self._draining:
+                raise EngineUnavailable("fleet stopping")
+            rid = self._next_rid
+            self._next_rid += 1
+        return self._engine_factory(rid)
+
     def retire_replica(self, rid: int, timeout: float = 60.0,
                        reason: str = "scale-down") -> bool:
         """Shrink the fleet by draining one replica out of rotation:
@@ -1104,4 +1183,5 @@ def build_fleet(
         )
         return InferenceEngine(runner, replica_id=rid, **ekw)
 
+    fleet_kwargs.setdefault("initial_weights", variables)
     return FleetRouter(factory, n_replicas, **fleet_kwargs)

@@ -47,6 +47,7 @@ from .engine import (
     ServeError,
 )
 from . import result_cache as result_cache_mod
+from .fleet import _Mirror
 from .router import DEAD, QUARANTINED, READY
 from .rpc import HostUnreachable, RpcClient, encode_tree_leaves
 
@@ -229,6 +230,7 @@ class GatewayRouter:
         default_timeout: Optional[float] = None,
         gossip=None,
         result_cache=None,
+        initial_leaves: Optional[list] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if isinstance(targets, Mapping):
@@ -259,7 +261,17 @@ class GatewayRouter:
         for hint, addr in items:
             self._hosts[hint] = _Host(hint, addr, client_factory(addr))
         self._generation = 0
-        self._last_leaves: Optional[list] = None  # reinstate re-push cache
+        # Depth-2 (generation, leaves) history, NEWEST FIRST.  The head
+        # backs the probe re-push; the second entry is the previous
+        # generation's retained tree, so deploy rollback
+        # (ctrl/deploy.py) is a local re-push, never a checkpoint
+        # reload.  ``initial_leaves`` seeds generation 0 when the caller
+        # knows the boot tree.
+        self._leaves_history: list[tuple[int, list]] = (
+            [] if initial_leaves is None else [(0, initial_leaves)]
+        )
+        # Shadow mirror hook (ctrl/deploy.py installs one per canary).
+        self._mirror: Optional[_Mirror] = None
         self._started = False
         self._stopped = False
         self._draining = False
@@ -390,6 +402,12 @@ class GatewayRouter:
             self._submitted += 1
             self._pending += 1
         req._on_done = self._request_done
+        mir = self._mirror
+        if mir is not None and mir.sample():
+            try:
+                mir.fn(image, req)
+            except Exception:  # noqa: BLE001 - mirror must not hurt callers
+                log.exception("gateway: shadow mirror hook failed")
         self._launch(req, view.host_id, is_hedge=False)
         if self.hedge_after is not None:
             timer = threading.Timer(
@@ -664,13 +682,27 @@ class GatewayRouter:
         # snapshotted the pod, and nothing later revisits this one.
         with self._swap_lock:
             with self._lock:
-                behind = (
-                    self._last_leaves is not None
-                    and h.generation < self._generation
-                )
                 target_gen = self._generation
-                leaves = self._last_leaves
-            if behind and leaves is not None:
+                # The re-push tree comes from the retained history entry
+                # that MATCHES the pod generation — never "the newest
+                # tree we happen to hold".  After a rollback the newest
+                # push preceding this probe may have been the bad
+                # candidate's; pairing it with the pod generation would
+                # reinstate the host onto exactly the weights the pod
+                # just abandoned.
+                leaves = None
+                for gen, lv in self._leaves_history:
+                    if gen == target_gen:
+                        leaves = lv
+                        break
+                behind = leaves is not None and h.generation < target_gen
+            if target_gen and leaves is None and h.generation < target_gen:
+                # Mid-transition: no retained tree carries the pod
+                # generation (a roll is rewriting history right now).
+                # Keep the host quarantined and retry next probe rather
+                # than reinstating it one generation stale.
+                return
+            if behind:
                 # Came back on an older generation: align before traffic.
                 try:
                     h.client.swap(leaves, generation=target_gen)
@@ -700,6 +732,30 @@ class GatewayRouter:
     def generation(self) -> int:
         with self._lock:
             return self._generation
+
+    def current_leaves(self) -> Optional[tuple[int, list]]:
+        """(generation, leaves) at the head of the retained history, or
+        None before any roll (and before ``initial_leaves`` seeding)."""
+        with self._lock:
+            return self._leaves_history[0] if self._leaves_history else None
+
+    def previous_leaves(self) -> Optional[tuple[int, list]]:
+        """(generation, leaves) of the generation BEFORE the current
+        one, or None when no history exists — the rollback source for
+        ctrl/deploy.py (re-published under a new, higher number)."""
+        with self._lock:
+            if len(self._leaves_history) < 2:
+                return None
+            return self._leaves_history[1]
+
+    def set_mirror(self, fn: Callable, rate: float) -> None:
+        """Install the shadow mirror: ``fn(image, req)`` runs for
+        roughly ``rate`` of accepted submissions right after launch, off
+        the caller's result path (same contract as FleetRouter)."""
+        self._mirror = _Mirror(fn, rate)
+
+    def clear_mirror(self) -> None:
+        self._mirror = None
 
     @property
     def pending(self) -> int:
@@ -748,26 +804,41 @@ class GatewayRouter:
     # -- weight roll -------------------------------------------------------
 
     def swap_weights(self, variables=None, *,
-                     leaves: Optional[list] = None) -> int:
+                     leaves: Optional[list] = None,
+                     generation: Optional[int] = None) -> int:
         """Pod-wide generation-tagged weight roll.
 
-        The gateway assigns ``generation = current + 1`` and rolls
-        routable hosts ONE AT A TIME through their RPC swap endpoint —
-        each host in turn performs its own replica-at-a-time roll, so
-        at every instant a response is served by weights that are
-        wholly old or wholly new, tagged with the generation that
-        produced it.  A host that fails its swap is quarantined; the
-        probe loop re-pushes the cached leaves before reinstating it.
-        Returns the new pod generation."""
+        The gateway assigns ``generation = current + 1`` (or the
+        explicit ``generation`` pin, which must advance — ctrl/deploy.py
+        pins the shadow's number on promote and a fresh higher number on
+        rollback) and rolls routable hosts ONE AT A TIME through their
+        RPC swap endpoint — each host in turn performs its own
+        replica-at-a-time roll, so at every instant a response is served
+        by weights that are wholly old or wholly new, tagged with the
+        generation that produced it.  A host that fails its swap is
+        quarantined; the probe loop re-pushes the retained tree matching
+        the pod generation before reinstating it.  Returns the new pod
+        generation."""
         if leaves is None:
             if variables is None:
                 raise ValueError("swap_weights needs variables or leaves")
             leaves = encode_tree_leaves(variables)
         with self._swap_lock:
             with self._lock:
-                target = self._generation + 1
+                target = (
+                    self._generation + 1 if generation is None
+                    else int(generation)
+                )
+                if target <= self._generation:
+                    raise ValueError(
+                        f"generation must advance: {target} <= "
+                        f"{self._generation}"
+                    )
                 self._generation = target
-                self._last_leaves = leaves
+                # Depth-2 history: retain the outgoing head as the
+                # rollback source, publish the new tree at the head.
+                self._leaves_history.insert(0, (target, leaves))
+                del self._leaves_history[2:]
             if self._cache is not None:
                 # Generation-keyed lookups can't see the old entries;
                 # dropping them now is memory hygiene.

@@ -29,10 +29,12 @@ Fault-tolerance hardening (docs/robustness.md):
 from __future__ import annotations
 
 import atexit
+import json
 import logging
 import os
 import threading
 import time
+import zlib
 from typing import Callable, Optional
 
 import jax
@@ -101,6 +103,134 @@ def close_managers() -> None:
 atexit.register(close_managers)
 
 
+def _state_step(state) -> int:
+    """Step number of ``state`` — TrainState attribute or dict key (the
+    deploy chaos/soak drills checkpoint plain variable pytrees)."""
+    step = getattr(state, "step", None)
+    if step is None and isinstance(state, dict):
+        step = state.get("step")
+    if step is None:
+        raise ValueError("state has no step (attribute or dict key)")
+    return int(step)
+
+
+def tree_crc(tree) -> int:
+    """Order-independent CRC32 of every leaf (shape + dtype + bytes).
+
+    Per-leaf digests are sorted before combining, so the same leaves
+    hashed through a ``TrainState`` and through its targetless-restore
+    dict (different flatten orders) produce the same value.
+    """
+    crcs = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        h = zlib.crc32(str((arr.shape, str(arr.dtype))).encode())
+        h = zlib.crc32(np.ascontiguousarray(arr).tobytes(), h)
+        crcs.append(h)
+    out = 0
+    for h in sorted(crcs):
+        out = zlib.crc32(h.to_bytes(4, "big"), out)
+    return out
+
+
+def manifest_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), f"manifest-{int(step)}.json")
+
+
+def _step_dir(ckpt_dir: str, step: int) -> Optional[str]:
+    root = os.path.abspath(ckpt_dir)
+    if not os.path.isdir(root):
+        return None
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if not os.path.isdir(full):
+            continue
+        digits = "".join(c for c in name if c.isdigit())
+        if digits and int(digits) == int(step):
+            return full
+    return None
+
+
+def write_manifest(ckpt_dir: str, step: int, state, *,
+                   valid: Optional[bool] = None) -> str:
+    """Write ``manifest-<step>.json`` next to the checkpoint: step,
+    param-tree CRC, validation status, and per-file size/CRC digests of
+    the landed step directory.  The Deployer (ctrl/deploy.py) verifies
+    the digests before ever deserializing a candidate, so a truncated or
+    tampered checkpoint is rejected at file level.  Atomic via
+    tmp+rename."""
+    if valid is None:
+        valid = finite_state(state)
+    manifest = {
+        "step": int(step),
+        "tree_crc": tree_crc(state),
+        "valid": bool(valid),
+    }
+    sdir = _step_dir(ckpt_dir, step)
+    if sdir is not None:
+        files = {}
+        for dirpath, _dirnames, filenames in os.walk(sdir):
+            for name in sorted(filenames):
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, sdir)
+                try:
+                    with open(full, "rb") as f:
+                        data = f.read()
+                except OSError:  # pragma: no cover - racing cleanup
+                    continue
+                files[rel] = {"bytes": len(data), "crc": zlib.crc32(data)}
+        manifest["files"] = files
+    path = manifest_path(ckpt_dir, step)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(ckpt_dir: str, step: int) -> Optional[dict]:
+    """The parsed manifest for ``step``, or None when missing/unreadable."""
+    try:
+        with open(manifest_path(ckpt_dir, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_manifest(ckpt_dir: str, step: int) -> tuple[bool, str]:
+    """File-level candidate verification — no deserialization.
+
+    Checks the manifest exists and parses, declared itself valid at save
+    time, and that every recorded checkpoint file still matches its
+    size + CRC digest (truncation/tampering shows up here)."""
+    path = manifest_path(ckpt_dir, step)
+    if not os.path.exists(path):
+        return False, "manifest_missing"
+    manifest = read_manifest(ckpt_dir, step)
+    if manifest is None:
+        return False, "manifest_unreadable"
+    if manifest.get("step") != int(step):
+        return False, "manifest_step_mismatch"
+    if manifest.get("valid") is not True:
+        return False, "invalid_at_save"
+    files = manifest.get("files")
+    if files:
+        sdir = _step_dir(ckpt_dir, step)
+        if sdir is None:
+            return False, "step_dir_missing"
+        for rel, rec in sorted(files.items()):
+            full = os.path.join(sdir, rel)
+            try:
+                with open(full, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return False, f"file_missing:{rel}"
+            if len(data) != rec.get("bytes") or \
+                    zlib.crc32(data) != rec.get("crc"):
+                return False, f"file_checksum_mismatch:{rel}"
+    return True, "ok"
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: TrainState,
@@ -108,12 +238,16 @@ def save_checkpoint(
     wait: bool = False,
     retries: int = 3,
     backoff: float = 0.5,
+    manifest: bool = True,
 ) -> None:
     """Save ``state`` at its step; retry with exponential backoff on I/O
     errors.  A step that is already on disk is left alone (the emergency
-    preemption save can race the cadence save at the same boundary)."""
+    preemption save can race the cadence save at the same boundary).
+    With ``manifest=True`` the async save is drained and a JSON manifest
+    (step, tree CRC, validation status, file digests) lands next to the
+    step directory for the Deployer's pre-deserialization checks."""
     mgr = _manager(ckpt_dir)
-    step = int(state.step)
+    step = _state_step(state)
     last_err: Optional[BaseException] = None
     for attempt in range(retries + 1):
         try:
@@ -132,13 +266,19 @@ def save_checkpoint(
                 delay,
             )
             time.sleep(delay)
-    if wait:
+    if wait or manifest:
         try:
             mgr.wait_until_finished()
         except Exception:
             if last_err is not None:
                 raise
             raise
+    if manifest and os.path.exists(manifest_path(ckpt_dir, step)) is False:
+        try:
+            write_manifest(ckpt_dir, step, state)
+        except Exception:  # pragma: no cover - manifest is advisory here
+            log.exception("writing checkpoint manifest for step %d failed",
+                          step)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:

@@ -1,0 +1,800 @@
+"""Continuous-deployment tests (docs/deployment.md).
+
+The shadow-canary pipeline in layers, cheapest first: the checkpoint
+manifest (written at save time, catches truncation/tampering before a
+byte is deserialized), the parity helpers, the full shadow-gate decision
+matrix against a REAL FleetRouter over weight-sensitive fake runners
+(parity-pass/SLO-fail, parity-fail/SLO-pass, golden-set arbitration,
+insufficient evidence), promote + burn-triggered rollback with the
+generation-monotonicity contract, journal crash-recovery (resume a
+half-finished roll, abandon a dead shadow, re-arm an unresolved watch
+window), and the retained-history plumbing in fleet and gateway — the
+quarantined-host-returns-mid-rollback probe pin lives here.
+tools/chaos.py repeats the reject and rollback stories against real
+subprocesses (``deploy_reject`` / ``deploy_rollback``).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu import obs
+from mx_rcnn_tpu.config import get_config
+from mx_rcnn_tpu.ctrl import Deployer, build_deployer
+from mx_rcnn_tpu.ctrl.deploy import (
+    PARITY_EXCLUDED_FIELDS,
+    comparable_payload,
+    golden_map,
+    payloads_equal,
+)
+from mx_rcnn_tpu.serve import HostUnreachable
+from mx_rcnn_tpu.serve.router import QUARANTINED, READY
+from mx_rcnn_tpu.train import checkpoint
+
+from test_fabric import StubHostClient, _gateway
+from test_serve import FakeRunner, _fleet, _img, _wait  # noqa: F401
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# Two weight trees whose first element drives the fake runner's output
+# signature: candidates equal to TREE_A are bitwise-parity-clean against
+# a TREE_A fleet; TREE_B candidates diverge (and their detection boxes
+# miss TREE_A's golden ground truth by construction).
+TREE_A = {"w": np.full((4,), 3.0, np.float32)}
+TREE_B = {"w": np.full((4,), 40.0, np.float32)}
+
+
+def _sig(tree):
+    """First element of the first (sorted) leaf — the knob the tests
+    turn to make outputs weight-dependent."""
+    if tree is None:
+        return 0.0
+    leaves = []
+
+    def walk(x):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                walk(x[k])
+        else:
+            leaves.append(np.asarray(x))
+
+    walk(tree)
+    return float(np.ravel(leaves[0])[0]) if leaves else 0.0
+
+
+def _sig_box(sig):
+    return np.array([[0.0, 0.0, 1.0 + sig, 1.0 + sig]], np.float32)
+
+
+class WeightRunner(FakeRunner):
+    """FakeRunner whose detections depend bitwise on the swapped tree:
+    two engines agree bitwise iff they hold equal weights."""
+
+    def __init__(self, *args, variables=None, **kw):
+        super().__init__(*args, **kw)
+        self.sig = _sig(variables)
+        self.swapped = []  # (generation, tree) in arrival order
+
+    def swap_weights(self, variables, generation=None):
+        gen = super().swap_weights(variables, generation=generation)
+        self.sig = _sig(variables)
+        self.swapped.append((gen, variables))
+        return gen
+
+    def run(self, mode, bucket, images):
+        out = super().run(mode, bucket, images)
+        for r in out:
+            r["boxes"] = _sig_box(self.sig)
+            r["scores"] = np.array([0.9], np.float32)
+            r["classes"] = np.zeros(1, np.int32)
+        return out
+
+
+def _weight_fleet(n=2, tree=TREE_A, delay=0.002):
+    fleet, runners = _fleet(
+        n,
+        runner_fn=lambda rid: WeightRunner(delay=delay, variables=tree),
+        initial_weights=tree,
+    )
+    return fleet, runners
+
+
+def _live_runners(runners, n=2):
+    """The initial in-rotation replicas only — the shared factory also
+    records the out-of-rotation shadow runner under a later rid."""
+    return [runners[rid] for rid in range(n)]
+
+
+class _Pump:
+    """Background live traffic: varied images so nothing coalesces."""
+
+    def __init__(self, fleet, period=0.004):
+        self.fleet = fleet
+        self.period = period
+        self.reqs = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="test-pump", daemon=True
+        )
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            img = np.full((32, 32, 3), (i % 31) * 0.5, np.float32)
+            try:
+                self.reqs.append(self.fleet.submit(img, timeout=10))
+            except Exception:  # noqa: BLE001 - shed under churn is fine
+                pass
+            i += 1
+            time.sleep(self.period)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def results(self):
+        out = []
+        for r in self.reqs:
+            try:
+                out.append(r.result(10))
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+
+def _save(ckpt_dir, step, tree):
+    checkpoint.save_checkpoint(
+        ckpt_dir, {"step": step, "variables": tree}, manifest=True
+    )
+
+
+def _deployer(fleet, ckpt_dir, **kw):
+    kw.setdefault("mirror_rate", 1.0)
+    kw.setdefault("min_mirrored", 3)
+    kw.setdefault("shadow_window_s", 10.0)
+    kw.setdefault("mirror_timeout_s", 5.0)
+    kw.setdefault("slo_fast_s", 2.0)
+    kw.setdefault("slo_slow_s", 6.0)
+    kw.setdefault("watch_window_s", 60.0)
+    return Deployer(fleet, ckpt_dir, **kw)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manifest
+# ---------------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_save_writes_verifiable_manifest(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        ok, reason = checkpoint.verify_manifest(d, 1)
+        assert (ok, reason) == (True, "ok")
+        m = checkpoint.read_manifest(d, 1)
+        assert m["step"] == 1
+        assert m["valid"] is True
+        assert m["files"]  # per-file digests landed
+        assert m["tree_crc"] == checkpoint.tree_crc(
+            {"step": 1, "variables": TREE_A}
+        )
+
+    def test_missing_manifest_rejected(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        import os
+        os.remove(checkpoint.manifest_path(d, 1))
+        assert checkpoint.verify_manifest(d, 1) == (False, "manifest_missing")
+
+    def test_corrupt_manifest_rejected(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        with open(checkpoint.manifest_path(d, 1), "w") as f:
+            f.write("{this is not json")
+        assert checkpoint.verify_manifest(d, 1) == (
+            False, "manifest_unreadable"
+        )
+
+    def test_wrong_step_rejected(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        m = checkpoint.read_manifest(d, 1)
+        m["step"] = 7
+        with open(checkpoint.manifest_path(d, 1), "w") as f:
+            json.dump(m, f)
+        assert checkpoint.verify_manifest(d, 1) == (
+            False, "manifest_step_mismatch"
+        )
+
+    def test_tampered_checkpoint_file_rejected(self, tmp_path):
+        import os
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        m = checkpoint.read_manifest(d, 1)
+        rel = max(m["files"], key=lambda r: m["files"][r]["bytes"])
+        sdir = checkpoint._step_dir(d, 1)
+        full = os.path.join(sdir, rel)
+        with open(full, "r+b") as f:
+            b = bytearray(f.read())
+            b[len(b) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(bytes(b))
+        ok, reason = checkpoint.verify_manifest(d, 1)
+        assert not ok
+        assert reason.startswith("file_checksum_mismatch:")
+
+    def test_invalid_at_save_rejected(self, tmp_path):
+        d = str(tmp_path)
+        bad = {"w": np.array([np.nan, 1.0], np.float32)}
+        _save(d, 1, bad)
+        assert checkpoint.verify_manifest(d, 1) == (
+            False, "invalid_at_save"
+        )
+
+
+# ---------------------------------------------------------------------------
+# parity helpers
+# ---------------------------------------------------------------------------
+
+
+class TestParity:
+    def test_volatile_and_provenance_fields_excluded(self):
+        a = {"boxes": np.ones((1, 4)), "level": "full", "generation": 3,
+             "latency_s": 0.1, "replica_id": 0, "host_id": "a",
+             "coalesced": True}
+        b = {"boxes": np.ones((1, 4)), "level": "full", "generation": 9,
+             "latency_s": 9.9, "replica_id": 5, "host_id": "b"}
+        assert set(comparable_payload(a)) == {"boxes", "level"}
+        assert payloads_equal(a, b)
+        for f in ("generation", "coalesced", "latency_s", "replica_id"):
+            assert f in PARITY_EXCLUDED_FIELDS
+
+    def test_bitwise_divergence_detected(self):
+        a = {"boxes": np.ones((1, 4), np.float32), "level": "full"}
+        b = {"boxes": np.ones((1, 4), np.float32), "level": "full"}
+        b["boxes"] = b["boxes"] + np.float32(1e-7)
+        assert not payloads_equal(a, b)
+
+    def test_key_set_mismatch_detected(self):
+        assert not payloads_equal({"boxes": 1}, {"boxes": 1, "extra": 2})
+
+    def test_golden_map_scores_hits_and_misses(self):
+        golden = {
+            "images": [np.zeros((8, 8, 3), np.float32)],
+            "gt": {0: {"0": {
+                "boxes": _sig_box(_sig(TREE_A)),
+                "difficult": np.zeros(1, bool),
+            }}},
+        }
+
+        def infer_a(img):
+            return {"boxes": _sig_box(_sig(TREE_A)),
+                    "scores": np.array([0.9]), "classes": np.zeros(1, int)}
+
+        def infer_b(img):
+            return {"boxes": _sig_box(_sig(TREE_B)),
+                    "scores": np.array([0.9]), "classes": np.zeros(1, int)}
+
+        assert golden_map(infer_a, golden) == pytest.approx(1.0)
+        assert golden_map(infer_b, golden) == pytest.approx(0.0)
+        assert golden_map(infer_a, {"images": [], "gt": {}}) is None
+
+
+# ---------------------------------------------------------------------------
+# shadow gate decision matrix (real FleetRouter, weight-sensitive runners)
+# ---------------------------------------------------------------------------
+
+
+class TestShadowGate:
+    def test_parity_pass_slo_fail_rejects(self, tmp_path):
+        # Identical weights -> bitwise parity holds; an impossible
+        # latency target makes the shadow-scoped SLO the only failure.
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        fleet, runners = _weight_fleet(delay=0.003)
+        with fleet:
+            dep = _deployer(fleet, d, latency_threshold_s=1e-4)
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "rejected"
+            assert out["reason"] == "shadow_slo"
+            v = out["verdict"]
+            assert v.mismatched == 0 and v.shadow_failures == 0
+            assert v.mirrored >= dep.min_mirrored
+            assert not v.slo_ok
+            latency = [x for x in v.slo_verdicts if x["kind"] == "latency"]
+            assert latency and not latency[0]["held"]
+        # The live fleet never rolled.
+        assert fleet.generation == 0
+        assert all(not r.swapped for r in _live_runners(runners))
+
+    def test_parity_fail_slo_pass_rejects(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_B)  # different weights than the live fleet
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d)
+            with _Pump(fleet) as pump:
+                out = dep.offer(1)
+                served = pump.results()
+            assert out["outcome"] == "rejected"
+            assert out["reason"] == "parity"
+            v = out["verdict"]
+            assert v.mismatched > 0
+            assert v.slo_ok
+        # The rejected candidate's generation never appears in any
+        # served response's tag, and its number is burned forever.
+        assert served
+        assert all(r["generation"] != v.generation for r in served)
+        assert fleet.generation == 0
+        assert dep._reserve_generation() > v.generation
+
+    def test_parity_fail_map_regression_rejects(self, tmp_path):
+        # Golden-set arbitration: divergent weights whose detections
+        # miss the live tree's ground truth are an mAP regression.
+        d = str(tmp_path)
+        _save(d, 1, TREE_B)
+        golden = {
+            "images": [np.zeros((32, 32, 3), np.float32)],
+            "gt": {0: {"0": {
+                "boxes": _sig_box(_sig(TREE_A)),
+                "difficult": np.zeros(1, bool),
+            }}},
+        }
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d, golden=golden)
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "rejected"
+            v = out["verdict"]
+            assert v.map_live == pytest.approx(1.0)
+            assert v.map_shadow == pytest.approx(0.0)
+            assert v.map_ok is False
+
+    def test_insufficient_mirrored_rejects(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(
+                fleet, d, min_mirrored=2, shadow_window_s=0.3,
+                mirror_timeout_s=0.2,
+            )
+            out = dep.offer(1)  # no traffic at all
+            assert out["outcome"] == "rejected"
+            assert out["reason"] == "insufficient_mirrored"
+            assert out["verdict"].mirrored < 2
+        assert fleet.generation == 0
+
+    def test_clean_candidate_promotes(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d, watch_window_s=0.0)
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "promoted"
+            assert out["verdict"].reason == "ok"
+            assert fleet.generation == out["generation"]
+            # Every live replica rolled onto the candidate tree.
+            for r in _live_runners(runners):
+                gen, tree = r.swapped[-1]
+                assert gen == out["generation"]
+                assert np.array_equal(tree["w"], TREE_A["w"])
+            res = fleet.infer(_img(16, 16), timeout=10)
+            assert res["generation"] == out["generation"]
+            kinds = [h["kind"] for h in dep.history]
+            assert kinds == ["deploy_candidate", "deploy_shadow_start",
+                             "deploy_shadow_verdict", "deploy_promote"]
+            # Promotion decided the step; nothing is pending.
+            assert dep.pending_candidates() == []
+
+    def test_corrupt_candidate_never_staged(self, tmp_path):
+        import os
+        d = str(tmp_path)
+        _save(d, 1, TREE_B)
+        m = checkpoint.read_manifest(d, 1)
+        rel = max(m["files"], key=lambda r: m["files"][r]["bytes"])
+        full = os.path.join(checkpoint._step_dir(d, 1), rel)
+        with open(full, "r+b") as f:
+            b = bytearray(f.read())
+            b[0] ^= 0xFF
+            f.seek(0)
+            f.write(bytes(b))
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d)
+            out = dep.offer(1)
+            assert out["outcome"] == "invalid"
+            assert out["reason"].startswith("file_checksum_mismatch")
+            kinds = [h["kind"] for h in dep.history]
+            assert kinds == ["deploy_candidate", "deploy_reject"]
+        # Rejected before deserialization: no shadow, no swap, no roll.
+        assert fleet.generation == 0
+        assert all(not r.swapped for r in _live_runners(runners))
+
+
+# ---------------------------------------------------------------------------
+# promote -> watch window -> burn-triggered rollback
+# ---------------------------------------------------------------------------
+
+
+class TestRollback:
+    def test_burn_inside_window_rolls_back_bitwise(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        live_slo = types.SimpleNamespace(alerts=[])
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d, live_slo=live_slo)
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "promoted"
+            promoted = out["generation"]
+            assert dep.check_watch() is None  # no burn yet
+            live_slo.alerts.append({
+                "event": "start", "slo": "availability", "t": 0.0,
+                "burn_fast": 37.5,
+            })
+            rb = dep.check_watch()
+            assert rb is not None
+            assert rb["from_generation"] == promoted
+            assert rb["to_generation"] > promoted  # never rewinds
+            assert rb["restored_generation"] == 0
+            assert rb["slo"] == "availability"
+            assert fleet.generation == rb["to_generation"]
+            # The restored tree is bitwise the pre-promote tree, and it
+            # went out under the NEW generation.
+            for r in _live_runners(runners):
+                gen, tree = r.swapped[-1]
+                assert gen == rb["to_generation"]
+                assert np.array_equal(tree["w"], TREE_A["w"])
+            res = fleet.infer(_img(16, 16), timeout=10)
+            assert res["generation"] == rb["to_generation"]
+            assert dep.history[-1]["kind"] == "deploy_rollback"
+            # The watch disarmed; a second check is a no-op.
+            assert dep.check_watch() is None
+
+    def test_quiet_window_disarms_without_rollback(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        live_slo = types.SimpleNamespace(alerts=[])
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(
+                fleet, d, live_slo=live_slo, watch_window_s=0.05
+            )
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "promoted"
+            time.sleep(0.1)
+            assert dep.check_watch() is None
+            assert fleet.generation == out["generation"]
+            assert dep._watch is None
+
+    def test_pre_promote_burn_alerts_do_not_count(self, tmp_path):
+        d = str(tmp_path)
+        _save(d, 1, TREE_A)
+        live_slo = types.SimpleNamespace(alerts=[
+            {"event": "start", "slo": "availability", "burn_fast": 9.0},
+        ])
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, d, live_slo=live_slo)
+            with _Pump(fleet):
+                out = dep.offer(1)
+            assert out["outcome"] == "promoted"
+            # Only alerts that started AFTER the promote trigger.
+            assert dep.check_watch() is None
+            assert fleet.generation == out["generation"]
+
+
+# ---------------------------------------------------------------------------
+# crash recovery from the journal
+# ---------------------------------------------------------------------------
+
+
+def _rec(kind, **payload):
+    return {"kind": kind, "payload": payload}
+
+
+class TestRecover:
+    def test_resume_promote_after_verdict(self, tmp_path):
+        # Killed between the promote verdict and a completed roll: the
+        # restart finishes the roll under the recorded generation.
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(
+                fleet, str(tmp_path),
+                loader=lambda step: {"variables": TREE_A},
+            )
+            summary = dep.recover(records=[
+                _rec("deploy_candidate", step=7, valid=True, reason="ok"),
+                _rec("deploy_shadow_start", step=7, generation=3,
+                     mirror_rate=1.0),
+                _rec("deploy_shadow_verdict", step=7, generation=3,
+                     verdict="promote", reason="ok"),
+            ])
+            assert summary["resumed"] == [7]
+            assert fleet.generation == 3
+            for r in _live_runners(runners):
+                assert r.swapped[-1][0] == 3
+            kinds = [h["kind"] for h in dep.history]
+            assert kinds == ["deploy_resume", "deploy_promote"]
+            assert dep.history[0]["action"] == "resume_promote"
+
+    def test_abandon_mid_shadow(self, tmp_path):
+        # Killed mid-shadow: the mirrored evidence died with the
+        # process; the candidate is abandoned and its generation burned.
+        fleet, runners = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, str(tmp_path))
+            summary = dep.recover(records=[
+                _rec("deploy_shadow_start", step=7, generation=3,
+                     mirror_rate=1.0),
+            ])
+            assert summary["abandoned"] == [7]
+            kinds = [h["kind"] for h in dep.history]
+            assert kinds == ["deploy_resume", "deploy_reject"]
+            assert dep.history[0]["action"] == "abandon"
+            assert fleet.generation == 0
+            assert all(not r.swapped for r in _live_runners(runners))
+            # The dead shadow's generation can never be issued again.
+            assert dep._reserve_generation() > 3
+
+    def test_rearm_watch_after_promote(self, tmp_path):
+        # Promote landed, watch window unresolved: re-arm a full window
+        # so a burn that fired while we were dead still rolls back.
+        live_slo = types.SimpleNamespace(alerts=[])
+        fleet, runners = _weight_fleet()
+        with fleet:
+            fleet.swap_weights(TREE_A, generation=3)  # the landed roll
+            dep = _deployer(fleet, str(tmp_path), live_slo=live_slo)
+            summary = dep.recover(records=[
+                _rec("deploy_shadow_start", step=7, generation=3,
+                     mirror_rate=1.0),
+                _rec("deploy_shadow_verdict", step=7, generation=3,
+                     verdict="promote", reason="ok"),
+                _rec("deploy_promote", step=7, generation=3,
+                     from_generation=0, watch_window_s=60.0),
+            ])
+            assert summary["rearmed"] == [7]
+            assert summary["decided"] == [7]
+            live_slo.alerts.append({
+                "event": "start", "slo": "availability", "burn_fast": 5.0,
+            })
+            rb = dep.check_watch()
+            assert rb is not None
+            assert rb["to_generation"] > 3
+            assert fleet.generation == rb["to_generation"]
+            # Restored bitwise from the retained previous generation.
+            _, tree = runners[0].swapped[-1]
+            assert np.array_equal(tree["w"], TREE_A["w"])
+
+    def test_settled_decisions_replay_as_decided(self, tmp_path):
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, str(tmp_path))
+            summary = dep.recover(records=[
+                _rec("deploy_candidate", step=5, valid=True, reason="ok"),
+                _rec("deploy_shadow_start", step=5, generation=2,
+                     mirror_rate=1.0),
+                _rec("deploy_shadow_verdict", step=5, generation=2,
+                     verdict="reject", reason="parity"),
+                _rec("deploy_reject", step=5, reason="parity"),
+                _rec("deploy_rollback", step=4, from_generation=2,
+                     to_generation=9, restored_generation=1),
+            ])
+            assert sorted(summary["decided"]) == [4, 5]
+            assert summary["resumed"] == []
+            assert summary["abandoned"] == []
+            assert dep.history == []  # replay emits nothing new
+            assert dep._reserve_generation() > 9
+
+    def test_journal_replays_through_obs_report(self, tmp_path):
+        # The deployment timeline reconstructs from artifacts alone.
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        obs_dir = str(tmp_path / "obs")
+        obs.configure(obs_dir, spans=False)
+        fleet, _ = _weight_fleet()
+        with fleet:
+            dep = _deployer(fleet, str(tmp_path / "ckpt"))
+            out = dep.offer(1)  # no checkpoint: manifest_missing
+            assert out["outcome"] == "invalid"
+        obs.close()
+        report, _ = obs_report.build_report(obs_dir)
+        kinds = [e["kind"] for e in report["incident_timeline"]]
+        assert kinds == ["deploy_candidate", "deploy_reject"]
+        assert report["incident_timeline"][0]["payload"]["reason"] == \
+            "manifest_missing"
+
+
+# ---------------------------------------------------------------------------
+# retained weight history: fleet and gateway
+# ---------------------------------------------------------------------------
+
+
+class TestRetainedHistory:
+    def test_fleet_depth_two_history(self):
+        fleet, _ = _weight_fleet(tree=TREE_A)
+        with fleet:
+            assert fleet.current_weights() == (0, TREE_A)
+            assert fleet.previous_weights() is None
+            g1 = fleet.swap_weights(TREE_B)
+            assert fleet.previous_weights() == (0, TREE_A)
+            tree_c = {"w": np.full((4,), 7.0, np.float32)}
+            g2 = fleet.swap_weights(tree_c)
+            assert fleet.previous_weights() == (g1, TREE_B)
+            assert fleet.current_weights() == (g2, tree_c)
+
+    def test_fleet_generation_must_advance(self):
+        fleet, _ = _weight_fleet()
+        with fleet:
+            fleet.swap_weights(TREE_B, generation=5)
+            with pytest.raises(ValueError):
+                fleet.swap_weights(TREE_A, generation=5)
+            with pytest.raises(ValueError):
+                fleet.swap_weights(TREE_A, generation=4)
+
+    def test_spare_engine_is_out_of_rotation(self):
+        fleet, runners = _weight_fleet(n=2)
+        with fleet:
+            spare = fleet.build_spare_engine()
+            spare.start()
+            try:
+                # The spare's rid is fresh and it never joins routing:
+                # a fleet roll does not touch it, and killing it is not
+                # a fleet event.
+                assert spare.replica_id not in (0, 1)
+                fleet.swap_weights(TREE_B)
+                assert spare.runner.generation == 0
+                res = spare.infer(_img(16, 16), timeout=5)
+                assert res["generation"] == 0
+                assert fleet.stats()["replicas"] == 2
+            finally:
+                spare.stop(drain=False)
+
+
+class _RecordingClient(StubHostClient):
+    """StubHostClient that retains the actual leaves each swap pushed."""
+
+    def __init__(self, host_id):
+        super().__init__(host_id)
+        self.swapped = []  # (generation, leaves)
+
+    def swap(self, leaves, generation=None, timeout_s=120.0):
+        out = super().swap(leaves, generation=generation,
+                           timeout_s=timeout_s)
+        self.swapped.append((generation, leaves))
+        return out
+
+
+class TestGatewayRollbackHistory:
+    L0 = [np.zeros(4, np.float32)]
+    L1 = [np.ones(4, np.float32)]
+
+    def _pod(self):
+        clients = {"a:1": _RecordingClient("hostA"),
+                   "b:1": _RecordingClient("hostB")}
+        gw = _gateway(clients, initial_leaves=self.L0).start()
+        return gw, clients
+
+    def test_gateway_depth_two_history(self):
+        gw, _ = self._pod()
+        try:
+            assert gw.current_leaves() == (0, self.L0)
+            assert gw.previous_leaves() is None
+            g1 = gw.swap_weights(leaves=self.L1)
+            assert gw.previous_leaves() == (0, self.L0)
+            g2 = gw.swap_weights(leaves=self.L0, generation=g1 + 1)
+            assert gw.current_leaves() == (g2, self.L0)
+            assert gw.previous_leaves() == (g1, self.L1)
+        finally:
+            gw.stop()
+
+    def test_quarantined_host_returning_mid_rollback_gets_pod_tree(self):
+        # The probe re-push must pair the pod generation with the
+        # RETAINED tree that carries it — after a rollback the newest
+        # push before the probe was the bad candidate's tree, and the
+        # old code would have reinstated the returning host onto
+        # exactly the weights the pod just abandoned.
+        gw, clients = self._pod()
+        try:
+            hb = next(
+                h for h in gw._hosts.values() if h.host_id == "hostB"
+            )
+            gw._quarantine(hb, "test: host down")
+            clients["b:1"].stats_error = HostUnreachable("down")
+            # Candidate goes out while B is away, then burns: rollback
+            # re-publishes L0 under a fresh higher generation.
+            g_bad = gw.swap_weights(leaves=self.L1)
+            g_roll = gw.swap_weights(leaves=self.L0, generation=g_bad + 1)
+            # B comes back, still on generation 0.
+            clients["b:1"].stats_error = None
+            gw._probe_host(hb)
+            assert hb.state == READY
+            gen, leaves = clients["b:1"].swapped[-1]
+            assert gen == g_roll
+            assert np.array_equal(leaves[0], self.L0[0])
+            # The abandoned candidate tree never reached B at all.
+            assert all(
+                not np.array_equal(lv[0], self.L1[0])
+                for _, lv in clients["b:1"].swapped
+            )
+            # The whole pod sits on one generation.
+            assert {h.generation for h in gw._hosts.values()} == {g_roll}
+        finally:
+            gw.stop()
+
+    def test_probe_holds_host_when_no_retained_tree_matches(self):
+        # Mid-transition guard: pod generation with no matching history
+        # entry keeps the returning host quarantined (retry next probe)
+        # instead of reinstating it one generation stale.
+        gw, clients = self._pod()
+        try:
+            hb = next(
+                h for h in gw._hosts.values() if h.host_id == "hostB"
+            )
+            gw._quarantine(hb, "test: host down")
+            with gw._lock:
+                gw._generation = 5  # roll in progress, history unsettled
+            gw._probe_host(hb)
+            assert hb.state == QUARANTINED
+            assert clients["b:1"].swapped == []
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# config wiring
+# ---------------------------------------------------------------------------
+
+
+class TestBuildDeployer:
+    def test_knobs_flow_from_config(self, tmp_path):
+        cfg = get_config("tiny_synthetic")
+        dep = build_deployer(
+            cfg, types.SimpleNamespace(), ckpt_dir=str(tmp_path)
+        )
+        dc = cfg.ctrl.deploy
+        assert dep.poll_s == dc.poll_s
+        assert dep.mirror_rate == dc.mirror_rate
+        assert dep.min_mirrored == dc.min_mirrored
+        assert dep.shadow_window_s == dc.shadow_window_s
+        assert dep.map_drop == dc.map_drop
+        assert dep.watch_window_s == dc.watch_window_s
+        assert dep.slo_fast_s == dc.burn_fast_s
+        assert dep.slo_slow_s == dc.burn_slow_s
+        assert dep.latency_threshold_s == dc.latency_threshold_s
+
+    def test_overrides_win(self, tmp_path):
+        cfg = get_config("tiny_synthetic")
+        dep = build_deployer(
+            cfg, types.SimpleNamespace(), ckpt_dir=str(tmp_path),
+            mirror_rate=1.0, min_mirrored=2,
+        )
+        assert dep.mirror_rate == 1.0
+        assert dep.min_mirrored == 2

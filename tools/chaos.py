@@ -1073,6 +1073,386 @@ def child_cross_host_swap_main() -> int:
     return 0
 
 
+# -- continuous-deployment scenarios (ctrl/deploy.py) -------------------------
+
+
+def _deploy_runner_cls():
+    """Weight-sensitive runner-protocol fake for the deploy children
+    (mirrors tools/soak.py::_SoakRunner — kept separate so the tool
+    never imports the test suite).  Every detection carries a signature
+    derived from the currently-loaded tree, so bitwise response parity
+    across engines holds if and only if their weights are bitwise
+    equal."""
+    import numpy as np
+
+    class _WeightRunner:
+        def __init__(self, variables, delay: float = 0.002):
+            self.buckets = [(64, 64)]
+            self.batch_size = 1
+            self.delay = delay
+            self.generation = 0
+            self.swapped: list = []
+            self._warmed = set()
+            self._sig = self._sig_of(variables)
+
+        @staticmethod
+        def _sig_of(tree) -> float:
+            leaves: list = []
+
+            def walk(x):
+                if isinstance(x, dict):
+                    for k in sorted(x):
+                        walk(x[k])
+                else:
+                    leaves.append(np.asarray(x))
+
+            walk(tree)
+            return float(np.ravel(leaves[0])[0]) if leaves else 0.0
+
+        def levels(self):
+            return ("full", "reduced", "proposals")
+
+        def pick_bucket(self, h, w):
+            return self.buckets[0]
+
+        def smaller_bucket(self, bucket):
+            return None
+
+        def warmup(self):
+            for b in self.buckets:
+                for mode in self.levels():
+                    self._warmed.add((mode, b))
+            return len(self._warmed)
+
+        def swap_weights(self, variables, generation=None):
+            gen = (self.generation + 1 if generation is None
+                   else int(generation))
+            if gen <= self.generation:
+                raise ValueError("generation must be monotonic")
+            self.generation = gen
+            self._sig = self._sig_of(variables)
+            self.swapped.append((gen, variables))
+            return gen
+
+        def run(self, mode, bucket, images):
+            assert (mode, tuple(bucket)) in self._warmed, (
+                f"RECOMPILATION on serving path: {(mode, bucket)}"
+            )
+            if self.delay:
+                time.sleep(self.delay)
+            s = self._sig
+            return [
+                {
+                    "boxes": np.array(
+                        [[0.0, 0.0, 1.0 + s, 1.0 + s]], np.float32
+                    ),
+                    "scores": np.array([0.9], np.float32),
+                    "classes": np.zeros(1, np.int32),
+                    "generation": self.generation,
+                }
+                for _ in images
+            ]
+
+    return _WeightRunner
+
+
+def _deploy_fleet(live_tree, delay: float = 0.002):
+    """(fleet, live-runner dict) over weight-sensitive fakes.  The
+    returned dict holds ONLY the in-rotation replicas — the Deployer's
+    spare canary engine reuses the same factory under a later rid, and
+    its swaps must never count as fleet rolls."""
+    from mx_rcnn_tpu.serve import FleetRouter, InferenceEngine
+
+    WeightRunner = _deploy_runner_cls()
+    n = 2
+    runners: dict = {}
+
+    def factory(rid: int) -> InferenceEngine:
+        r = WeightRunner(live_tree, delay=delay)
+        runners[rid] = r
+        return InferenceEngine(r, replica_id=rid, hang_timeout=60.0)
+
+    fleet = FleetRouter(
+        factory, n, supervisor_poll=0.1, initial_weights=live_tree,
+    )
+    return fleet, runners, n
+
+
+def child_deploy_reject_main() -> int:
+    """Two poisoned candidates land under live traffic: a corrupt
+    checkpoint (bit-flipped after its manifest was written) and a
+    healthy-on-disk tree whose detections regress on the golden set.
+    Both must be rejected — and no served response may EVER carry a
+    candidate generation tag (rejected generations are burned)."""
+    _hermetic_cpu()
+    import numpy as np
+    from mx_rcnn_tpu import obs
+    from mx_rcnn_tpu.ctrl import Deployer
+    from mx_rcnn_tpu.train import checkpoint
+
+    obs_dir = os.environ.get("MX_RCNN_OBS_DIR")
+    if obs_dir:
+        obs.configure(obs_dir)
+
+    live_tree = {"w": np.full((8,), 3.0, np.float32)}
+    bad_tree = {"w": np.full((8,), 40.0, np.float32)}
+    fleet, runners, n_live = _deploy_fleet(live_tree)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="mx_rcnn_deploy_reject_")
+    # Step 1: a clean save, then one flipped byte in the landed files —
+    # the manifest checksum must refuse it BEFORE deserialization.
+    checkpoint.save_checkpoint(
+        ckpt_dir, {"step": 1, "variables": bad_tree}, manifest=True
+    )
+    manifest = checkpoint.read_manifest(ckpt_dir, 1)
+    rel = max(manifest["files"], key=lambda r: manifest["files"][r]["bytes"])
+    blob = os.path.join(checkpoint._step_dir(ckpt_dir, 1), rel)
+    with open(blob, "r+b") as f:
+        raw = bytearray(f.read())
+        raw[len(raw) // 2] ^= 0xFF
+        f.seek(0)
+        f.write(bytes(raw))
+    # Step 2: restores fine, but every detection moves away from the
+    # live tree's golden ground truth (parity fails AND mAP regresses).
+    checkpoint.save_checkpoint(
+        ckpt_dir, {"step": 2, "variables": bad_tree}, manifest=True
+    )
+
+    live_sig = 3.0
+    golden = {
+        "images": [np.zeros((32, 32, 3), np.float32)],
+        "gt": {0: {"0": {
+            "boxes": np.array(
+                [[0.0, 0.0, 1.0 + live_sig, 1.0 + live_sig]], np.float32
+            ),
+            "difficult": np.zeros(1, bool),
+        }}},
+    }
+
+    served: list = []
+    errors: list = []
+    stop = threading.Event()
+
+    def pump() -> None:
+        i = 0
+        while not stop.is_set():
+            img = np.full((32, 32, 3), float(i % 13), np.float32)
+            try:
+                served.append(fleet.infer(img, timeout=60))
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            i += 1
+            time.sleep(0.004)
+
+    with fleet:
+        dep = Deployer(
+            fleet, ckpt_dir,
+            mirror_rate=1.0, min_mirrored=5, shadow_window_s=30.0,
+            mirror_timeout_s=15.0, slo_fast_s=2.0, slo_slow_s=6.0,
+            watch_window_s=30.0, golden=golden,
+        )
+        pumps = [
+            threading.Thread(target=pump, daemon=True) for _ in range(2)
+        ]
+        for t in pumps:
+            t.start()
+        wait_for(lambda: len(served) >= 5, 120)
+        decisions = dep.step_once()
+        stop.set()
+        for t in pumps:
+            t.join(60)
+
+    burned = sorted(
+        h["generation"] for h in dep.history
+        if h["kind"] == "deploy_shadow_start"
+    )
+    gens_served = sorted({r["generation"] for r in served})
+    leaked = [g for g in gens_served if g in burned]
+    print(json.dumps({
+        "decisions": [
+            {"step": d["step"], "outcome": d["outcome"],
+             "reason": d.get("reason")}
+            for d in decisions
+        ],
+        "responses": len(served),
+        "generations_served": gens_served,
+        "candidate_generations": burned,
+        "leaked_generations": leaked,
+        "fleet_generation": fleet.generation,
+        "live_swaps": sum(
+            len(runners[rid].swapped) for rid in range(n_live)
+        ),
+        "errors": errors,
+    }))
+    assert not errors, f"live requests failed during rejection: {errors}"
+    assert len(decisions) == 2, decisions
+    assert decisions[0]["outcome"] == "invalid", decisions[0]
+    assert decisions[0]["reason"].startswith("file_checksum_mismatch"), \
+        decisions[0]
+    assert decisions[1]["outcome"] == "rejected", decisions[1]
+    assert decisions[1]["reason"] == "parity", decisions[1]
+    assert fleet.generation == 0, fleet.generation
+    assert all(not runners[rid].swapped for rid in range(n_live)), (
+        "a live replica was swapped despite both candidates failing the gate"
+    )
+    assert served and gens_served == [0], gens_served
+    assert not leaked, (
+        f"rejected candidate generation(s) {leaked} appeared in served "
+        "responses"
+    )
+    return 0
+
+
+def child_deploy_rollback_main() -> int:
+    """Promote a parity-clean candidate, then inject latency so the
+    LIVE SLO burns inside the post-promote watch window: the Deployer
+    must automatically re-publish the previous generation's retained
+    tree — bitwise — under a NEW, HIGHER generation number, landing the
+    whole fleet back on a single generation."""
+    _hermetic_cpu()
+    import numpy as np
+    from mx_rcnn_tpu import obs
+    from mx_rcnn_tpu.config import CtrlConfig
+    from mx_rcnn_tpu.ctrl import Deployer, SLOEngine, default_slos
+    from mx_rcnn_tpu.train import checkpoint
+
+    obs_dir = os.environ.get("MX_RCNN_OBS_DIR")
+    if obs_dir:
+        obs.configure(obs_dir)
+
+    live_tree = {"w": np.full((8,), 3.0, np.float32)}
+    # Bitwise-equal weights under a fresh step: parity passes, the
+    # regression is an SLO burn AFTER promotion, not an accuracy drop.
+    cand_tree = {"w": np.full((8,), 3.0, np.float32)}
+    fleet, runners, n_live = _deploy_fleet(live_tree)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="mx_rcnn_deploy_rollback_")
+    checkpoint.save_checkpoint(
+        ckpt_dir, {"step": 1, "variables": cand_tree}, manifest=True
+    )
+
+    ctrl = CtrlConfig(latency_target=0.9, latency_threshold_s=0.05)
+    live_slo = SLOEngine(
+        default_slos(ctrl), fast_s=2.0, slow_s=6.0, burn_factor=2.0,
+    ).start(0.2)
+
+    served: list = []
+    errors: list = []
+    stop = threading.Event()
+
+    def pump() -> None:
+        i = 0
+        while not stop.is_set():
+            img = np.full((32, 32, 3), float(i % 13), np.float32)
+            try:
+                served.append(fleet.infer(img, timeout=60))
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                errors.append(f"{type(e).__name__}: {e}")
+                return
+            i += 1
+            time.sleep(0.004)
+
+    rollback = None
+    try:
+        with fleet:
+            # Shadow-scoped availability is relaxed: the spare engine's
+            # bounded queue can shed a burst under the 1.0 mirror rate,
+            # and a single shed in ~15 samples would fail a 0.95 target
+            # — this scenario's regression is the post-promote LIVE
+            # latency burn, not shadow capacity.
+            dep = Deployer(
+                fleet, ckpt_dir,
+                mirror_rate=1.0, min_mirrored=5, shadow_window_s=30.0,
+                mirror_timeout_s=15.0, slo_fast_s=2.0, slo_slow_s=6.0,
+                watch_window_s=120.0, live_slo=live_slo,
+                availability_target=0.5,
+            )
+            pumps = [
+                threading.Thread(target=pump, daemon=True)
+                for _ in range(2)
+            ]
+            for t in pumps:
+                t.start()
+            wait_for(lambda: len(served) >= 5, 120)
+            decisions = dep.step_once()
+            assert decisions and decisions[-1]["outcome"] == "promoted", \
+                decisions
+            promoted_gen = decisions[-1]["generation"]
+            wait_for(
+                lambda: any(
+                    r["generation"] == promoted_gen for r in list(served)
+                ),
+                120,
+            )
+            # The new generation misbehaves in production: every live
+            # request now lands far above the latency SLO threshold.
+            for rid in range(n_live):
+                runners[rid].delay = 0.3
+            deadline = time.monotonic() + 90
+            while rollback is None and time.monotonic() < deadline:
+                for d in dep.step_once():
+                    if d["outcome"] == "rolled_back":
+                        rollback = d
+                time.sleep(0.2)
+            for rid in range(n_live):
+                runners[rid].delay = 0.002  # lift so the drain is quick
+            stop.set()
+            for t in pumps:
+                t.join(60)
+            wait_for(
+                lambda: rollback is not None and any(
+                    r[0] == rollback["to_generation"]
+                    for rid in range(n_live)
+                    for r in runners[rid].swapped
+                ),
+                60,
+            )
+    finally:
+        live_slo.stop()
+
+    assert rollback is not None, (
+        "live SLO burn inside the watch window never triggered rollback"
+    )
+    restored = [runners[rid].swapped[-1] for rid in range(n_live)]
+    bitwise = all(
+        gen == rollback["to_generation"]
+        and sorted(tree) == sorted(live_tree)
+        and all(np.array_equal(tree[k], live_tree[k]) for k in tree)
+        for gen, tree in restored
+    )
+    pod_gens = sorted({runners[rid].generation for rid in range(n_live)})
+    gens_served = sorted({r["generation"] for r in served})
+    print(json.dumps({
+        "promoted_generation": promoted_gen,
+        "from_generation": rollback["from_generation"],
+        "to_generation": rollback["to_generation"],
+        "restored_generation": rollback["restored_generation"],
+        "burn_slo": rollback["slo"],
+        "bitwise_restore": bitwise,
+        "pod_generations": pod_gens,
+        "generations_served": gens_served,
+        "responses": len(served),
+        "errors": errors,
+    }))
+    assert not errors, f"live requests failed during the roll: {errors}"
+    assert rollback["from_generation"] == promoted_gen, rollback
+    assert rollback["to_generation"] > promoted_gen, (
+        "rollback rewound the generation number: "
+        f"{rollback['to_generation']} <= {promoted_gen}"
+    )
+    assert fleet.generation == rollback["to_generation"], fleet.generation
+    assert bitwise, (
+        "rollback did not restore the previous generation's tree bitwise"
+    )
+    assert pod_gens == [rollback["to_generation"]], (
+        f"pod split across generations after rollback: {pod_gens}"
+    )
+    assert set(gens_served) <= {0, promoted_gen,
+                                rollback["to_generation"]}, gens_served
+    return 0
+
+
 def compare_main(dir_a: str, dir_b: str) -> int:
     """Bitwise-compare the newest checkpoints of two run dirs."""
     _hermetic_cpu()
@@ -1873,6 +2253,63 @@ def scenario_cross_host_swap(root: str, steps: int, timeout: float) -> dict:
     return r
 
 
+# -- continuous-deployment scenarios ------------------------------------------
+
+
+def _deploy_timeline(obs_dir: str) -> list:
+    """Incident-timeline kinds reconstructed from the journal ALONE —
+    the acceptance bar for the deploy scenarios is that the whole
+    deployment story replays from the obs artifacts."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    report, _ = obs_report.build_report(obs_dir)
+    return [e["kind"] for e in report["incident_timeline"]]
+
+
+def scenario_deploy_reject(root: str, steps: int, timeout: float) -> dict:
+    obs_dir = os.path.join(root, "deploy_reject", "obs")
+    r = _json_child(root, "deploy_reject", "--child-deploy-reject", timeout,
+                    env={"MX_RCNN_OBS_DIR": obs_dir})
+    assert not r["errors"] and not r["leaked_generations"], r
+    assert r["fleet_generation"] == 0 and r["live_swaps"] == 0, r
+    assert [d["outcome"] for d in r["decisions"]] == \
+        ["invalid", "rejected"], r
+
+    tl = _deploy_timeline(obs_dir)
+    assert tl.count("deploy_candidate") == 2, tl
+    assert tl.count("deploy_reject") == 2, tl
+    assert "deploy_promote" not in tl, tl
+    # The corrupt candidate died at the manifest (no shadow); the
+    # regressed one went through a full shadow verdict first.
+    assert tl.count("deploy_shadow_start") == 1, tl
+    assert tl.index("deploy_shadow_verdict") < \
+        len(tl) - tl[::-1].index("deploy_reject"), tl
+    r["timeline"] = tl
+    return r
+
+
+def scenario_deploy_rollback(root: str, steps: int, timeout: float) -> dict:
+    obs_dir = os.path.join(root, "deploy_rollback", "obs")
+    r = _json_child(root, "deploy_rollback", "--child-deploy-rollback",
+                    timeout, env={"MX_RCNN_OBS_DIR": obs_dir})
+    assert not r["errors"] and r["bitwise_restore"], r
+    assert r["to_generation"] > r["promoted_generation"], r
+    assert r["pod_generations"] == [r["to_generation"]], r
+
+    tl = _deploy_timeline(obs_dir)
+    for kind in ("deploy_candidate", "deploy_shadow_start",
+                 "deploy_shadow_verdict", "deploy_promote",
+                 "slo_burn_start", "deploy_rollback"):
+        assert kind in tl, (kind, tl)
+    assert tl.index("deploy_promote") < tl.index("slo_burn_start"), tl
+    assert tl.index("slo_burn_start") < tl.index("deploy_rollback"), tl
+    r["timeline"] = tl
+    return r
+
+
 SCENARIOS = {
     "baseline": scenario_baseline,
     "sigkill": scenario_sigkill,
@@ -1895,6 +2332,8 @@ SCENARIOS = {
     "host_kill": scenario_host_kill,
     "host_partition": scenario_host_partition,
     "cross_host_swap": scenario_cross_host_swap,
+    "deploy_reject": scenario_deploy_reject,
+    "deploy_rollback": scenario_deploy_rollback,
 }
 
 # Scenarios that restore/compare against baseline's checkpoint.
@@ -1914,6 +2353,7 @@ LOCKCHECK_SCENARIOS = {
     "overload", "hang", "replica_kill", "replica_wedge",
     "swap_under_load", "fleet_drain", "fleet_scale",
     "host_kill", "host_partition", "cross_host_swap",
+    "deploy_reject", "deploy_rollback",
 }
 
 
@@ -1946,6 +2386,10 @@ def main(argv=None) -> int:
         return child_host_partition_main()
     if argv and argv[0] == "--child-cross-host-swap":
         return child_cross_host_swap_main()
+    if argv and argv[0] == "--child-deploy-reject":
+        return child_deploy_reject_main()
+    if argv and argv[0] == "--child-deploy-rollback":
+        return child_deploy_rollback_main()
     if argv and argv[0] == "--compare":
         return compare_main(argv[1], argv[2])
 

@@ -53,6 +53,9 @@ INCIDENT_KINDS = frozenset({
     "slo_burn_start", "slo_burn_stop",
     "fleet_scale_up", "fleet_scale_down",
     "fleet_replica_added", "fleet_replica_retired",
+    "gateway_weight_roll",
+    "deploy_candidate", "deploy_shadow_start", "deploy_shadow_verdict",
+    "deploy_promote", "deploy_reject", "deploy_rollback", "deploy_resume",
 })
 
 

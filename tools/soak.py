@@ -16,7 +16,12 @@ One process runs, concurrently:
 * **chaos** — a replica kill at mid-run (quarantine -> rebuild under
   load), and optionally (``--data-chaos``) a data-path chaos scenario
   (cache corruption + decode-worker kill) as concurrent subprocesses,
-  rehearsing the input service failing while serving burns.
+  rehearsing the input service failing while serving burns;
+* **deployment** (``--deploy``) — a fresh validated checkpoint lands
+  mid-soak and a :class:`~mx_rcnn_tpu.ctrl.Deployer` stages, gates and
+  rolls it live (docs/deployment.md): the BENCH record carries the
+  whole shadow -> promote/reject story and the per-SLO verdicts must
+  hold THROUGH the roll for the run to pass.
 
 Verdict: the run PASSES only if every SLO held (whole-run error budget
 not exhausted) and no accepted request was lost.  Prints
@@ -152,6 +157,59 @@ def _build_real_fleet(args):
     )
 
 
+def _drop_deploy_candidate(args, ckpt_dir: str) -> None:
+    """Land a validated step-1 checkpoint mid-soak.  Runs off the
+    arrival loop's thread — a real-model init there would distort the
+    latency SLO the run is judged on.  The real-engine candidate is the
+    same seed-0 tree the fleet already serves (bitwise parity -> the
+    roll itself is the event under test); the fake-engine candidate is
+    a toy tree the weight-agnostic runners accept."""
+    import numpy as np
+
+    from mx_rcnn_tpu.train import checkpoint
+
+    if args.fake_engines:
+        variables = {"w": np.zeros((4,), np.float32)}
+    else:
+        import jax
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
+
+        cfg = get_config(args.config)
+        variables = init_detector(
+            TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
+            cfg.data.image_size,
+        )
+    checkpoint.save_checkpoint(
+        ckpt_dir, {"step": 1, "variables": variables},
+        wait=True, manifest=True,
+    )
+
+
+def _deploy_story(deployer, t0: float) -> dict:
+    """The shadow -> gate -> promote/reject (-> rollback) story from
+    the Deployer's own journal mirror, soak-clock timestamps."""
+    keep = ("step", "generation", "reason", "verdict", "mirrored",
+            "compared", "mismatched", "from_generation", "to_generation",
+            "restored_generation", "slo")
+    kinds = [h["kind"] for h in deployer.history]
+    return {
+        "ckpt_dir": deployer.ckpt_dir,
+        "timeline": [
+            dict({k: h[k] for k in keep if k in h},
+                 kind=h["kind"], t_s=round(h["t"] - t0, 2))
+            for h in deployer.history
+        ],
+        "promoted": "deploy_promote" in kinds,
+        "rejected": "deploy_reject" in kinds,
+        "rolled_back": "deploy_rollback" in kinds,
+        "decided": any(
+            k in kinds for k in ("deploy_promote", "deploy_reject")
+        ),
+    }
+
+
 def _spawn_data_chaos(root: str) -> list[subprocess.Popen]:
     """Data-path chaos concurrent with the serving soak: the input
     service corrupting cache entries and losing decode workers while
@@ -222,6 +280,31 @@ def run_soak(args: argparse.Namespace) -> dict:
         p99_window_s=max(fast_s, 5.0),
     ).start(args.ctrl_period)
 
+    deployer = None
+    deploy_drop_t: list[float] = []
+    if args.deploy:
+        import tempfile
+
+        from mx_rcnn_tpu.config import get_config
+        from mx_rcnn_tpu.ctrl import build_deployer
+
+        deploy_ckpt = args.deploy_ckpt_dir or tempfile.mkdtemp(
+            prefix="soak_deploy_ckpt_"
+        )
+        # Soak-scaled deploy knobs over cfg.ctrl.deploy: the gate must
+        # settle inside one run, and the watch window spans the rest of
+        # it so a post-roll burn still triggers rollback before the
+        # verdict is read.
+        deployer = build_deployer(
+            get_config(args.config), fleet,
+            ckpt_dir=deploy_ckpt, live_slo=slo_engine,
+            poll_s=max(0.3, args.ctrl_period),
+            mirror_rate=1.0, min_mirrored=4,
+            shadow_window_s=min(8.0, args.duration * 0.25),
+            watch_window_s=args.duration,
+        ).start(recover=False)
+        print(f"[soak] deploy: watching {deploy_ckpt}", file=sys.stderr)
+
     # Diurnal sine modulated by spike bursts: base * burst-multiplier.
     base = make_profile(
         "sine", args.qps, amplitude=args.amplitude,
@@ -274,6 +357,16 @@ def run_soak(args: argparse.Namespace) -> dict:
             continue
         t = now - t0
         next_at += 1.0 / (base(t) * burst(t))
+        if deployer is not None and not deploy_drop_t \
+                and t >= args.duration * 0.3:
+            deploy_drop_t.append(t)
+            threading.Thread(
+                target=_drop_deploy_candidate,
+                args=(args, deployer.ckpt_dir),
+                name="soak-deploy-drop", daemon=True,
+            ).start()
+            print(f"[soak] deploy: candidate step 1 landing at "
+                  f"t={t:.1f}s", file=sys.stderr)
         if args.kill_replica and killed_rid is None \
                 and t >= args.duration * 0.4:
             # Kill a currently-routable replica (rids are sparse under
@@ -307,6 +400,8 @@ def run_soak(args: argparse.Namespace) -> dict:
           file=sys.stderr)
     for th in pending:
         th.join(timeout=args.deadline + 120.0)
+    if deployer is not None:
+        deployer.stop()
     scaler.stop()
     slo_engine.stop()   # runs a final observe() so verdicts cover the tail
     stats = fleet.stats()
@@ -392,6 +487,13 @@ def run_soak(args: argparse.Namespace) -> dict:
             for d in scaler.resize_timeline()
         ],
         "data_chaos": chaos,
+        "deploy": None if deployer is None else dict(
+            _deploy_story(deployer, t0),
+            dropped_at_s=(
+                round(deploy_drop_t[0], 2) if deploy_drop_t else None
+            ),
+            generation_final=fleet.generation,
+        ),
         "obs": {"run_id": obs.run_id(), "dir": obs.out_dir()},
     }
     obs.close()
@@ -442,6 +544,14 @@ def main(argv=None) -> int:
     p.add_argument("--data-chaos", action="store_true",
                    help="run cache-corruption + decode-worker-kill "
                         "chaos scenarios as concurrent subprocesses")
+    p.add_argument("--deploy", action="store_true",
+                   help="land a fresh checkpoint mid-soak and run the "
+                        "continuous-deployment pipeline (ctrl/deploy.py) "
+                        "against the live fleet; the BENCH record gains "
+                        "the shadow->promote/reject story")
+    p.add_argument("--deploy-ckpt-dir", default=None,
+                   help="--deploy: checkpoint dir to land the candidate "
+                        "in (default: a temp dir)")
     p.add_argument("--obs-dir", default=None,
                    help="obs journal/spans dir (default: a temp dir)")
     args = p.parse_args(argv)
@@ -449,8 +559,11 @@ def main(argv=None) -> int:
         import tempfile
 
         args.obs_dir = tempfile.mkdtemp(prefix="soak_obs_")
-    if not args.fake_engines:
-        _hermetic_cpu(args.max_replicas)
+    if not args.fake_engines or args.deploy:
+        # --deploy needs jax either way: the candidate checkpoint is
+        # saved/restored through train/checkpoint.py.  +1 device slot
+        # covers the out-of-rotation shadow replica.
+        _hermetic_cpu(args.max_replicas + 1)
 
     rec = run_soak(args)
 
@@ -458,6 +571,11 @@ def main(argv=None) -> int:
     ok = held and rec["failed"] == 0 and rec["completed"] > 0
     if args.data_chaos and rec["data_chaos"] is not None:
         ok = ok and all(c["rc"] == 0 for c in rec["data_chaos"])
+    if args.deploy:
+        # The deployment must have reached a decision, and the per-SLO
+        # verdicts above must hold THROUGH the roll — a promote that
+        # burns the budget fails the soak even after rollback.
+        ok = ok and rec["deploy"] is not None and rec["deploy"]["decided"]
     rec["held"] = held
     rec["pass"] = ok
     print(json.dumps(rec))
@@ -468,6 +586,15 @@ def main(argv=None) -> int:
               f"held={v['held']}", file=sys.stderr)
     print(f"[soak] fleet resizes: +{rec['added']} -{rec['retired']} "
           f"(final {rec['replicas_final']})", file=sys.stderr)
+    if rec.get("deploy"):
+        d = rec["deploy"]
+        story = "promoted" if d["promoted"] else (
+            "rejected" if d["rejected"] else "undecided"
+        )
+        if d["rolled_back"]:
+            story += " then rolled back"
+        print(f"[soak] deploy: candidate {story}; fleet at generation "
+              f"{d['generation_final']}", file=sys.stderr)
     print(f"[soak] SLO VERDICT: {'HELD' if held else 'VIOLATED'}",
           file=sys.stderr)
     if not ok:
