@@ -99,7 +99,7 @@ _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
 # programming/usage errors (they surface as 500s on purpose).
 RAISE_ALLOW = frozenset({
     "ServeError", "Overloaded", "EngineUnavailable", "DeadlineExceeded",
-    "HostUnreachable",
+    "QuotaExceeded", "HostUnreachable",
     "ValueError", "TypeError", "KeyError", "RuntimeError", "TimeoutError",
     "NotImplementedError", "AssertionError", "OSError", "StopIteration",
     "_error",  # serve handler-local typed-error factory

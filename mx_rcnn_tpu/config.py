@@ -559,6 +559,31 @@ class CtrlConfig:
 
 
 @dataclass(frozen=True)
+class TenancyConfig:
+    """Multi-tenant admission (serve/tenancy.py), read as
+    cfg.serve.tenancy.* — the knob table lives in docs/serving.md.
+
+    Host-side only: tenancy never reaches a traced module, so no knob
+    here can change a compiled program."""
+
+    # Master switch.  Off keeps every admission path and metric series
+    # bit-identical to the single-tenant build.
+    enabled: bool = False
+    # Compact tenant table: "name:weight=4,rate=50,burst=20,priority=0;
+    # name2:..." (serve/tenancy.py::parse_table).  A string (not nested
+    # config) so `--set serve.tenancy.table=...` works through
+    # apply_overrides' scalar coercion.
+    table: str = ""
+    # Where unknown/absent wire tokens land (never a 500); shares this
+    # tenant's bucket and label.
+    default_tenant: str = "default"
+    # Burn-governor degrade action: a tenant-scoped SLO burn alert
+    # multiplies that tenant's admitted rate by this factor until the
+    # alert clears (serve/tenancy.py::QuotaGovernor).
+    tighten_factor: float = 0.25
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Serving-engine defaults consumed by serve/engine.py::build_engine
     and serve/fleet.py::build_fleet (explicit kwargs still win)."""
@@ -591,6 +616,10 @@ class ServeConfig:
     # (tools/loadgen.py defaults its fleets to 256); chaos/fault drills
     # keep it off so every request exercises a real replica.
     result_cache_capacity: int = 0
+    # Multi-tenant admission: per-tenant token-bucket quotas +
+    # weighted-fair pack shares, read as cfg.serve.tenancy.*
+    # (docs/serving.md tenancy section).
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
 
 
 @dataclass(frozen=True)

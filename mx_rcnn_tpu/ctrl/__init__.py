@@ -40,6 +40,7 @@ from mx_rcnn_tpu.ctrl.slo import (
     default_slos,
     good_total,
     merged_percentile,
+    tenant_slos,
 )
 
 
@@ -63,6 +64,7 @@ __all__ = [
     "default_slos",
     "good_total",
     "merged_percentile",
+    "tenant_slos",
     "Autoscaler",
     "ScalePolicy",
     "ScaleSignals",

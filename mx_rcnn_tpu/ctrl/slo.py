@@ -47,7 +47,7 @@ from mx_rcnn_tpu.obs.metrics import (
 log = logging.getLogger("mx_rcnn_tpu.ctrl")
 
 __all__ = ["SLO", "SLOEngine", "default_slos", "good_total",
-           "merged_percentile"]
+           "merged_percentile", "tenant_slos"]
 
 AVAILABILITY_METRIC = "fleet_requests_total"
 LATENCY_METRIC = "serve_request_latency_seconds"
@@ -62,6 +62,10 @@ class SLO:
     kind: str = "availability"          # "availability" | "latency"
     threshold_s: Optional[float] = None  # latency: good = under this
     level: Optional[str] = None          # latency: one degrade level only
+    # Tenant-scoped SLO (serve/tenancy.py): only events labeled
+    # tenant=<this> count.  The label set is bounded by the configured
+    # tenant table, so per-tenant SLOs can't explode either.
+    tenant: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not 0.0 < self.target < 1.0:
@@ -82,16 +86,28 @@ def good_total(slo: SLO, snapshot: dict) -> tuple[float, float]:
         for label, v in series.items():
             if isinstance(v, dict):
                 continue
+            labels = parse_labels(label)
+            if slo.tenant is not None and \
+                    labels.get("tenant") != slo.tenant:
+                continue
+            if labels.get("outcome") == "quota":
+                # The tenant's own budget talking (a contractual 429 +
+                # Retry-After), not the fleet refusing a user: quota
+                # rejections burn neither the fleet-wide budget nor the
+                # capped tenant's own (docs/autoscaling.md).
+                continue
             total += v
-            if parse_labels(label).get("outcome") == "completed":
+            if labels.get("outcome") == "completed":
                 good += v
         return good, total
     good = total = 0.0
     for label, summ in snapshot.get(LATENCY_METRIC, {}).items():
         if not isinstance(summ, dict):
             continue
-        if slo.level is not None and \
-                parse_labels(label).get("level") != slo.level:
+        labels = parse_labels(label)
+        if slo.level is not None and labels.get("level") != slo.level:
+            continue
+        if slo.tenant is not None and labels.get("tenant") != slo.tenant:
             continue
         le = summ.get("le") or []
         counts = summ.get("buckets") or []
@@ -139,6 +155,25 @@ def default_slos(ctrl_cfg) -> tuple[SLO, ...]:
     )
 
 
+def tenant_slos(ctrl_cfg, tenants: Sequence[str]) -> tuple[SLO, ...]:
+    """The :func:`default_slos` pair instantiated per tenant, over the
+    tenant-labeled series (serve/tenancy.py).  SLO names embed the
+    tenant (``availability[victim]``) so the budget gauge, burn alerts,
+    and verdict table attribute blame by name alone."""
+    out: list[SLO] = []
+    for t in tenants:
+        out.append(SLO(
+            f"availability[{t}]", target=ctrl_cfg.availability_target,
+            tenant=t,
+        ))
+        out.append(SLO(
+            f"latency[{t}]", target=ctrl_cfg.latency_target,
+            kind="latency", threshold_s=ctrl_cfg.latency_threshold_s,
+            tenant=t,
+        ))
+    return tuple(out)
+
+
 class SLOEngine:
     """Evaluate SLOs over snapshots; journal burn alerts; export budget.
 
@@ -156,10 +191,16 @@ class SLOEngine:
         slow_s: float = 3600.0,
         burn_factor: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
+        on_alert: Optional[Callable[[str, SLO, dict], None]] = None,
     ) -> None:
         if fast_s <= 0 or slow_s < fast_s:
             raise ValueError("need 0 < fast_s <= slow_s")
         self.slos = tuple(slos)
+        # Alert hook: called as on_alert("start"|"stop", slo, payload)
+        # on every burn transition.  serve/tenancy.py::QuotaGovernor
+        # attaches here so a tenant-scoped burn tightens only that
+        # tenant's quota instead of shedding the fleet.
+        self.on_alert = on_alert
         self.fast_s = float(fast_s)
         self.slow_s = float(slow_s)
         self.burn_factor = float(burn_factor)
@@ -235,20 +276,26 @@ class SLOEngine:
                     "fast_s": self.fast_s, "burn_slow": burn_slow,
                     "slow_s": self.slow_s, "budget_remaining": budget,
                 }
+                if slo.tenant is not None:
+                    payload["tenant"] = slo.tenant
                 obs.emit("ctrl", "slo_burn_start", payload, logger=log)
                 obs.counter(
                     "slo_burn_alerts_total", "burn-rate alert starts"
                 ).inc(slo=slo.name)
                 with self._lock:
                     self.alerts.append(dict(payload, event="start", t=t))
+                self._fire_alert("start", slo, payload)
             elif stop:
                 payload = {
                     "slo": slo.name, "active_s": t - active_since,
                     "budget_remaining": budget,
                 }
+                if slo.tenant is not None:
+                    payload["tenant"] = slo.tenant
                 obs.emit("ctrl", "slo_burn_stop", payload, logger=log)
                 with self._lock:
                     self.alerts.append(dict(payload, event="stop", t=t))
+                self._fire_alert("stop", slo, payload)
             self._registry.gauge(
                 "slo_error_budget_remaining",
                 "fraction of the SLO error budget left (negative = "
@@ -263,6 +310,14 @@ class SLOEngine:
         with self._lock:
             self._states = states
         return states
+
+    def _fire_alert(self, event: str, slo: SLO, payload: dict) -> None:
+        if self.on_alert is None:
+            return
+        try:
+            self.on_alert(event, slo, payload)
+        except Exception:  # noqa: BLE001 - a hook must not stop evaluation
+            log.exception("slo on_alert hook failed")
 
     def replay(self, records: Sequence[dict]) -> dict:
         """Feed every ``metrics_flush`` journal record through
@@ -294,6 +349,7 @@ class SLOEngine:
                 "target": slo.target,
                 "threshold_s": slo.threshold_s,
                 "level": slo.level,
+                "tenant": slo.tenant,
                 "good": st.get("good", 0.0),
                 "total": st.get("total", 0.0),
                 "budget_remaining": round(budget, 6),

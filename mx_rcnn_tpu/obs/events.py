@@ -203,6 +203,27 @@ def _fmt_held_lock_blocked_call(p: dict) -> str:
     ).format(**p)
 
 
+def _fmt_tenant_quota_exceeded(p: dict) -> str:
+    return (
+        "quota: tenant {tenant} over its admission budget at the "
+        "{layer} layer — request rejected with Retry-After"
+    ).format(**p)
+
+
+def _fmt_tenant_quota_tightened(p: dict) -> str:
+    return (
+        "quota governor: tightening tenant {tenant} to {factor:.0%} of "
+        "its configured rate (burn on slo {slo})"
+    ).format(**p)
+
+
+def _fmt_tenant_quota_restored(p: dict) -> str:
+    return (
+        "quota governor: tenant {tenant} restored to full rate "
+        "(burn cleared on slo {slo})"
+    ).format(**p)
+
+
 def _fmt_slo_burn_start(p: dict) -> str:
     return (
         "slo {slo}: burn-rate alert START — {burn_fast:.1f}x over "
@@ -359,6 +380,10 @@ EVENTS: dict[str, tuple[int, Callable[[dict], str]]] = {
     "weight_swap": (logging.INFO, _fmt_weight_swap),
     "fleet_replica_added": (logging.INFO, _fmt_fleet_replica_added),
     "fleet_replica_retired": (logging.INFO, _fmt_fleet_replica_retired),
+    # multi-tenancy (serve/tenancy.py, serve/fleet.py, serve/engine.py)
+    "tenant_quota_exceeded": (logging.DEBUG, _fmt_tenant_quota_exceeded),
+    "tenant_quota_tightened": (logging.WARNING, _fmt_tenant_quota_tightened),
+    "tenant_quota_restored": (logging.INFO, _fmt_tenant_quota_restored),
     # control plane (mx_rcnn_tpu/ctrl/)
     "slo_burn_start": (logging.WARNING, _fmt_slo_burn_start),
     "slo_burn_stop": (logging.INFO, _fmt_slo_burn_stop),

@@ -30,6 +30,7 @@ from mx_rcnn_tpu.serve.engine import (
     InferenceRequest,
     Overloaded,
     Plan,
+    QuotaExceeded,
     ServeError,
     build_engine,
 )
@@ -49,6 +50,11 @@ from mx_rcnn_tpu.serve.gossip import (
 from mx_rcnn_tpu.serve.health import EngineHealth
 from mx_rcnn_tpu.serve.result_cache import ResultCache, content_key
 from mx_rcnn_tpu.serve.rpc import HostRpcServer, HostUnreachable, RpcClient
+from mx_rcnn_tpu.serve.tenancy import (
+    QuotaGovernor,
+    TenancyPolicy,
+    TenantSpec,
+)
 from mx_rcnn_tpu.serve.router import (
     DEAD,
     DEGRADED,
@@ -75,6 +81,7 @@ __all__ = [
     "InferenceRequest",
     "Overloaded",
     "Plan",
+    "QuotaExceeded",
     "ServeError",
     "build_engine",
     "FleetRequest",
@@ -91,6 +98,9 @@ __all__ = [
     "HostRpcServer",
     "HostUnreachable",
     "RpcClient",
+    "QuotaGovernor",
+    "TenancyPolicy",
+    "TenantSpec",
     "EngineHealth",
     "ResultCache",
     "content_key",

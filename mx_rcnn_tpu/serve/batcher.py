@@ -24,6 +24,21 @@ Packing rules (docs/serving.md):
   request, possibly landing in the same pack) and with the
   ``HysteresisPlanner`` ladder (whose per-request level choice already
   happened at plan time).
+* **Anti-starvation aging.**  Deadline-first alone can starve: a
+  deadline-less request on program B waits forever while deadlined
+  program-A leads keep arriving.  Every request passed over by
+  ``max_passovers`` consecutive packs is promoted to lead the next one,
+  so FIFO degeneration is bounded — any buffered request reaches the
+  device within ``max_passovers + 1`` packs of arriving
+  (tests/test_tenancy.py pins the regression).
+* **Weighted-fair tenant shares.**  With a :class:`TenancyPolicy`
+  (serve/tenancy.py), the lead is chosen priority-class first (lower
+  class drains earlier), and a tenant's slots in each pack are capped
+  at its weight's share of ``batch_size`` — a flooding tenant cannot
+  crowd program-mates out of the call.  Ordering *within* a tenant
+  stays deadline-first, caps are work-conserving (unused share is
+  refilled by urgency), and requests without a tenant fold to the
+  default tenant so the single-tenant path is unchanged.
 * **Bitwise identity.**  Rows in a padded micro-batch are independent
   through letterbox, the jitted graph, and per-row postprocess, so a
   request's de-interleaved response is bitwise identical whether it
@@ -58,8 +73,14 @@ class PackBuffer:
     buffer itself is just the ordered pool those requests wait in.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, tenancy=None, max_passovers: int = 4) -> None:
         self._items: list = []
+        self._tenancy = tenancy
+        # A request passed over by this many consecutive packs leads the
+        # next one.  > 1 so one urgent newcomer can still jump the line
+        # (deadline-first stays the common case).
+        self._max_passovers = max(2, int(max_passovers))
+        self._passovers: dict[int, int] = {}  # id(req) -> packs missed
 
     def __len__(self) -> int:
         return len(self._items)
@@ -77,25 +98,104 @@ class PackBuffer:
             if r.deadline is not None and now > r.deadline
         ]
         if expired:
-            dead = set(id(r) for r in expired)
-            self._items = [r for r in self._items if id(r) not in dead]
+            self._remove(expired)
         return expired
 
+    def _remove(self, taken: list) -> None:
+        dead = set(id(r) for r in taken)
+        self._items = [r for r in self._items if id(r) not in dead]
+        for rid in dead:
+            self._passovers.pop(rid, None)
+
+    def _tenant_of(self, req) -> str:
+        t = getattr(req, "tenant", None)
+        return self._tenancy.resolve(t) if self._tenancy is not None else ""
+
+    def _pick_lead(self):
+        """Aged request first (most-starved wins); else priority class +
+        urgency when tenancy is on; else pure urgency."""
+        aged = [
+            r for r in self._items
+            if self._passovers.get(id(r), 0) >= self._max_passovers
+        ]
+        if aged:
+            return max(
+                aged,
+                key=lambda r: (self._passovers[id(r)],
+                               tuple(-u for u in urgency(r))),
+            )
+        if self._tenancy is not None:
+            return min(
+                self._items,
+                key=lambda r: (self._tenancy.priority(self._tenant_of(r)),
+                               *urgency(r)),
+            )
+        return min(self._items, key=urgency)
+
+    def _fill_fair(self, lead, mates: list, batch_size: int) -> list:
+        """Weighted-fair pack composition: per-tenant slot caps from the
+        tenant table, priority-class order across tenants, deadline-first
+        within a tenant, work-conserving second pass."""
+        by_tenant: dict[str, list] = {}
+        for r in [lead] + mates:
+            by_tenant.setdefault(self._tenant_of(r), []).append(r)
+        weights = {
+            t: self._tenancy.weight(t) for t in by_tenant
+        }
+        total_w = sum(weights.values())
+        caps = {
+            t: max(1, int(math.floor(batch_size * w / total_w)))
+            for t, w in weights.items()
+        }
+        order = sorted(
+            mates,
+            key=lambda r: (self._tenancy.priority(self._tenant_of(r)),
+                           *urgency(r)),
+        )
+        group = [lead]
+        used = {self._tenant_of(lead): 1}
+        leftovers = []
+        for r in order:
+            if len(group) >= batch_size:
+                break
+            t = self._tenant_of(r)
+            if used.get(t, 0) >= caps[t]:
+                leftovers.append(r)
+                continue
+            group.append(r)
+            used[t] = used.get(t, 0) + 1
+        # Work-conserving: unfilled slots go to whoever is most urgent,
+        # caps ignored — fairness never costs occupancy.
+        for r in leftovers:
+            if len(group) >= batch_size:
+                break
+            group.append(r)
+        return group
+
     def take(self, batch_size: int) -> Optional[list]:
-        """One pack: the most urgent request plus up to ``batch_size - 1``
-        program-mates, most urgent first.  None when empty."""
+        """One pack: the lead request plus up to ``batch_size - 1``
+        program-mates.  None when empty."""
         if not self._items:
             return None
-        lead = min(self._items, key=urgency)
+        lead = self._pick_lead()
         key = lead.plan[1:]  # (mode, bucket) — the compiled program
-        group = sorted(
-            (r for r in self._items if r.plan[1:] == key), key=urgency
-        )[:batch_size]
-        picked = set(id(r) for r in group)
-        self._items = [r for r in self._items if id(r) not in picked]
+        mates = sorted(
+            (r for r in self._items
+             if r is not lead and r.plan[1:] == key),
+            key=urgency,
+        )
+        if self._tenancy is not None and batch_size > 1:
+            group = self._fill_fair(lead, mates, batch_size)
+        else:
+            group = [lead] + mates[:batch_size - 1]
+        self._remove(group)
+        for r in self._items:  # everyone left behind aged one pack
+            rid = id(r)
+            self._passovers[rid] = self._passovers.get(rid, 0) + 1
         return group
 
     def drain(self) -> list:
         """Remove and return everything (engine shutdown/failure path)."""
         items, self._items = self._items, []
+        self._passovers.clear()
         return items

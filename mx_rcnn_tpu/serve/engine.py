@@ -50,6 +50,7 @@ import numpy as np
 
 from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.serve import health as health_mod
+from mx_rcnn_tpu.serve import tenancy as tenancy_mod
 from mx_rcnn_tpu.serve.batcher import PackBuffer
 from mx_rcnn_tpu.serve.degrade import (
     FULL_QUALITY_LEVELS,
@@ -77,6 +78,16 @@ class EngineUnavailable(ServeError):
     """The engine cannot serve (not started, stopped, or declared dead)."""
 
 
+class QuotaExceeded(ServeError):
+    """The caller's tenant is over its token-bucket quota
+    (serve/tenancy.py).  Distinct from :class:`Overloaded` on purpose:
+    quota is the tenant's own budget, not fleet pressure — it maps to
+    429 + Retry-After on the wire and never feeds the autoscaler's
+    shed-rate signal."""
+
+    retry_after_s: float = 1.0  # wire hint; admission sets the real value
+
+
 class Plan(NamedTuple):
     level: str              # degrade.LEVELS entry
     mode: str               # program family: full | reduced | proposals
@@ -88,13 +99,16 @@ class InferenceRequest:
 
     __slots__ = ("image", "enqueued_at", "deadline", "_event", "_result",
                  "_error", "plan", "_callbacks", "_cb_lock",
-                 "trace_id", "span", "queue_span")
+                 "trace_id", "span", "queue_span", "tenant")
 
     def __init__(self, image: np.ndarray, enqueued_at: float,
                  deadline: Optional[float]) -> None:
         self.image = image
         self.enqueued_at = enqueued_at
         self.deadline = deadline
+        # Resolved tenant name (serve/tenancy.py) — None on the
+        # single-tenant path; the batcher folds None to the default.
+        self.tenant: Optional[str] = None
         self._event = threading.Event()
         self._result: Optional[dict] = None
         self._error: Optional[BaseException] = None
@@ -610,9 +624,19 @@ class InferenceEngine:
         clock: Callable[[], float] = time.monotonic,
         pack: bool = True,
         pack_window_s: float = 0.0,
+        tenancy=None,
+        tenancy_admit: bool = True,
     ) -> None:
         self.runner = runner
         self._clock = clock
+        # Multi-tenancy (serve/tenancy.py): the shared TenancyPolicy, or
+        # None for the single-tenant path (metric series stay
+        # bit-identical).  ``tenancy_admit`` is False when an outer
+        # admission layer (serve/fleet.py) already charged the quota —
+        # the engine then only uses the policy for labels and
+        # weighted-fair packing, never double-charging a request.
+        self._tenancy = tenancy
+        self._tenancy_admit = bool(tenancy_admit) and tenancy is not None
         # Continuous batching is only meaningful with slots to fill; at
         # batch_size == 1 the legacy take path is byte-for-byte the same
         # behavior with less machinery, so keep it.
@@ -636,7 +660,9 @@ class InferenceEngine:
         )
         self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=max_queue)
         self._carry = None  # InferenceRequest | _STOP carried across takes
-        self._buf = PackBuffer()   # planned requests awaiting a pack
+        # Planned requests awaiting a pack; tenancy makes the pack
+        # composition weighted-fair (serve/batcher.py).
+        self._buf = PackBuffer(tenancy=self._tenancy)
         self._stop_parked = False  # STOP seen; buffer flushes first
         self._occ_calls = 0        # device calls (occupancy denominator)
         self._occ_filled = 0       # request slots filled across them
@@ -746,12 +772,15 @@ class InferenceEngine:
         self, image: np.ndarray, timeout: Optional[float] = None,
         trace_id: Optional[str] = None,
         parent_span_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> InferenceRequest:
         """Enqueue one image; returns immediately.  Raises
-        :class:`Overloaded` when the queue is full, or
-        :class:`EngineUnavailable` when the engine cannot serve.
-        ``trace_id``/``parent_span_id`` link the request's spans under a
-        caller's trace (the fleet router passes its attempt span)."""
+        :class:`Overloaded` when the queue is full,
+        :class:`QuotaExceeded` when a standalone engine's tenancy policy
+        rejects the tenant, or :class:`EngineUnavailable` when the
+        engine cannot serve.  ``trace_id``/``parent_span_id`` link the
+        request's spans under a caller's trace (the fleet router passes
+        its attempt span)."""
         if not self._started:
             raise EngineUnavailable("engine not started")
         if self._draining or self._stopping:
@@ -760,11 +789,26 @@ class InferenceEngine:
             raise EngineUnavailable(
                 f"engine is dead: {self.health.reason}"
             )
+        if self._tenancy is not None:
+            tenant = self._tenancy.resolve(tenant)
+            if self._tenancy_admit and not self._tenancy.admit(tenant):
+                tlabel = self._tenancy.label(tenant)
+                obs.counter(
+                    "serve_quota_exceeded_total",
+                    "requests rejected by per-tenant quota",
+                ).inc(tenant=tlabel, **self._mlabels)
+                obs.emit("serve", "tenant_quota_exceeded", {
+                    "tenant": tlabel, "layer": "engine",
+                }, logger=log)
+                err = QuotaExceeded(f"tenant {tenant!r} over quota")
+                err.retry_after_s = self._tenancy.retry_after_s(tenant)
+                raise err
         now = self._clock()
         timeout = self.default_timeout if timeout is None else timeout
         req = InferenceRequest(
             image, now, None if timeout is None else now + timeout
         )
+        req.tenant = tenant
         req.trace_id = trace_id
         if obs.spans_enabled():
             req.span = obs.span(
@@ -780,7 +824,7 @@ class InferenceEngine:
             self._note_pressure()
             obs.counter(
                 "serve_shed_total", "requests shed by admission control"
-            ).inc(**self._mlabels)
+            ).inc(**self._req_labels(tenant))
             obs.emit("serve", "shed", {
                 "queue_depth": self._queue.qsize(),
                 "max_queue": self._queue.maxsize,
@@ -794,11 +838,19 @@ class InferenceEngine:
             ) from None
         obs.counter(
             "serve_requests_total", "requests admitted"
-        ).inc(**self._mlabels)
+        ).inc(**self._req_labels(tenant))
         obs.gauge(
             "serve_queue_depth", "accepted-but-unserved requests"
         ).set(self._queue.qsize(), **self._mlabels)
         return req
+
+    def _req_labels(self, tenant: Optional[str]) -> dict:
+        """Per-request metric labels: replica always; tenant only when
+        tenancy is configured (series stay bit-identical otherwise),
+        folded to the bounded vocabulary by the policy."""
+        if self._tenancy is None:
+            return self._mlabels
+        return dict(self._mlabels, tenant=self._tenancy.label(tenant))
 
     def infer(
         self, image: np.ndarray, timeout: Optional[float] = None
@@ -1054,8 +1106,18 @@ class InferenceEngine:
                     self._inflight_plan = None
                     self._inflight_reqs = []
             if not self.health.alive():
-                # The watchdog declared us dead while this call was stuck;
-                # its requests were already failed.  Drop the zombie result.
+                # The watchdog declared us dead while this call was stuck
+                # (its requests were already failed), or a kill() raced
+                # this batch between the queue pop and the _inflight_reqs
+                # registration — that sweep misses requests this thread
+                # held in hand, so fail whatever is still unresolved
+                # instead of dropping it to wait out its caller's
+                # deadline.  Drop the zombie result either way.
+                dead = EngineUnavailable("engine died mid-batch")
+                for r in batch:
+                    if not r.done():
+                        r._set_error(dead)
+                self._fail_pending(dead)
                 break
             latency = self._clock() - start
             if err is not None:
@@ -1102,7 +1164,8 @@ class InferenceEngine:
                     obs.histogram(
                         "serve_request_latency_seconds",
                         "served request latency (device call to result)",
-                    ).observe(latency, level=level, **self._mlabels)
+                    ).observe(latency, level=level,
+                              **self._req_labels(r.tenant))
                     res = dict(res)
                     res["level"] = level
                     res["latency_s"] = latency
@@ -1191,6 +1254,10 @@ def build_engine(
     if serve_cfg is not None:
         engine_kwargs.setdefault("pack", serve_cfg.pack)
         engine_kwargs.setdefault("pack_window_s", serve_cfg.pack_window_s)
+        if "tenancy" not in engine_kwargs:
+            engine_kwargs["tenancy"] = tenancy_mod.TenancyPolicy.from_config(
+                serve_cfg.tenancy
+            )
     runner = DetectorRunner(
         cfg, variables, buckets=buckets, batch_size=batch_size,
         int8_head=int8_head, int8_network=int8_network, device=device,

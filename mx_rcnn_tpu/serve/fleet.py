@@ -50,11 +50,13 @@ from typing import Callable, Optional, Sequence, Union
 from mx_rcnn_tpu import obs
 from mx_rcnn_tpu.analysis import lockcheck
 from mx_rcnn_tpu.serve import result_cache as result_cache_mod
+from mx_rcnn_tpu.serve import tenancy as tenancy_mod
 from mx_rcnn_tpu.serve.engine import (
     DeadlineExceeded,
     EngineUnavailable,
     InferenceEngine,
     Overloaded,
+    QuotaExceeded,
     ServeError,
 )
 from mx_rcnn_tpu.serve.router import (
@@ -84,6 +86,8 @@ class FleetRequest:
         self.enqueued_at = enqueued_at
         self.deadline = deadline
         self.bucket: Optional[tuple[int, int]] = None
+        # Resolved tenant name (serve/tenancy.py); None single-tenant.
+        self.tenant: Optional[str] = None
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: Optional[dict] = None
@@ -232,6 +236,7 @@ class FleetRouter:
         default_timeout: Optional[float] = None,
         result_cache=None,
         initial_weights=None,
+        tenancy=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if n_replicas < 1:
@@ -242,6 +247,12 @@ class FleetRouter:
         # Content-addressed response cache + coalescing registry
         # (serve/result_cache.py); None disables both.
         self._cache = result_cache
+        # Multi-tenancy (serve/tenancy.py): the router is THE quota
+        # layer — it charges each logical request's token exactly once,
+        # before cache consult or placement, so retries/hedges/cache
+        # hits never double-charge.  None keeps the single-tenant path
+        # (and its metric series) bit-identical.
+        self._tenancy = tenancy
         self.n_replicas = n_replicas
         self.hedge_after = hedge_after
         self.max_attempts = max_attempts
@@ -282,6 +293,10 @@ class FleetRouter:
         self._completed = 0
         self._failed = 0
         self._shed = 0
+        # Quota rejections are NOT sheds: the autoscaler's shed-rate
+        # signal reads _shed, and a quota-capped flooder must not be
+        # able to trigger a scale-up (docs/autoscaling.md).
+        self._quota = 0
         self._hedges = 0
         self._hedge_wins = 0
         self._retries_total = 0
@@ -298,11 +313,17 @@ class FleetRouter:
         with self._lock:
             return list(self._replicas.values())
 
-    def _count_outcome(self, outcome: str) -> None:
+    def _count_outcome(self, outcome: str,
+                       tenant: Optional[str] = None) -> None:
+        labels = {"outcome": outcome}
+        if self._tenancy is not None:
+            # Folded to the bounded vocabulary; per-tenant SLOs
+            # (ctrl/slo.py) filter on this label.
+            labels["tenant"] = self._tenancy.label(tenant)
         obs.counter(
             "fleet_requests_total",
             "fleet requests by final outcome",
-        ).inc(outcome=outcome)
+        ).inc(**labels)
 
     def start(self) -> "FleetRouter":
         if self._started:
@@ -369,22 +390,49 @@ class FleetRouter:
     # -- client API --------------------------------------------------------
 
     def submit(self, image, timeout: Optional[float] = None,
-               trace_id: Optional[str] = None) -> FleetRequest:
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> FleetRequest:
         """Route one image; returns immediately.  Raises
-        :class:`Overloaded` when every routable replica shed it, or
-        :class:`EngineUnavailable` when no replica can serve.
-        ``trace_id`` stamps the request's span tree (loadgen passes one
-        per synthetic request); one is minted when spans are recording
-        and none was given."""
+        :class:`Overloaded` when every routable replica shed it,
+        :class:`QuotaExceeded` when the caller's tenant is over its
+        token-bucket quota, or :class:`EngineUnavailable` when no
+        replica can serve.  ``trace_id`` stamps the request's span tree
+        (loadgen passes one per synthetic request); one is minted when
+        spans are recording and none was given.  ``tenant`` is the
+        caller's tenancy token — unknown/absent folds to the default
+        tenant (serve/tenancy.py)."""
         if not self._started:
             raise EngineUnavailable("fleet not started")
         if self._draining or self._stopped:
             raise EngineUnavailable("fleet stopping")
+        if self._tenancy is not None:
+            # Quota gate: ONE token per logical request, charged before
+            # the cache consult and before any placement — a request
+            # that will be answered from cache still spent its tenant's
+            # budget, and retries/hedges below never charge again.
+            tenant = self._tenancy.resolve(tenant)
+            if not self._tenancy.admit(tenant):
+                tlabel = self._tenancy.label(tenant)
+                with self._lock:
+                    self._submitted += 1
+                    self._quota += 1
+                self._count_outcome("quota", tenant)
+                obs.counter(
+                    "serve_quota_exceeded_total",
+                    "requests rejected by per-tenant quota",
+                ).inc(tenant=tlabel, replica="-")
+                obs.emit("serve", "tenant_quota_exceeded", {
+                    "tenant": tlabel, "layer": "fleet",
+                }, logger=log)
+                err = QuotaExceeded(f"tenant {tenant!r} over quota")
+                err.retry_after_s = self._tenancy.retry_after_s(tenant)
+                raise err
         now = self._clock()
         timeout = self.default_timeout if timeout is None else timeout
         freq = FleetRequest(
             image, now, None if timeout is None else now + timeout
         )
+        freq.tenant = tenant
         freq.trace_id = trace_id
         if obs.spans_enabled():
             freq.span = obs.span(
@@ -407,7 +455,7 @@ class FleetRouter:
                     with self._lock:
                         self._submitted += 1
                         self._completed += 1
-                    self._count_outcome("completed")
+                    self._count_outcome("completed", freq.tenant)
                     freq._latch_result(hit)
                     return freq
                 if self._cache.coalesce(ckey, gen, freq):
@@ -429,7 +477,7 @@ class FleetRouter:
             with self._lock:
                 self._submitted += 1
                 self._shed += 1
-            self._count_outcome("shed")
+            self._count_outcome("shed", freq.tenant)
             if freq.span is not None:
                 freq.span.end(error="Overloaded")
             self._abort_cached(freq, Overloaded("leader shed"))
@@ -438,7 +486,7 @@ class FleetRouter:
             with self._lock:
                 self._submitted += 1
                 self._failed += 1
-            self._count_outcome("failed")
+            self._count_outcome("failed", freq.tenant)
             if freq.span is not None:
                 freq.span.end(error=type(e).__name__)
             self._abort_cached(freq, e)
@@ -482,13 +530,13 @@ class FleetRouter:
                     with self._lock:
                         self._completed += 1
                         self._pending -= 1
-                    self._count_outcome("completed")
+                    self._count_outcome("completed", f.tenant)
             else:
                 if f._latch_error(err):
                     with self._lock:
                         self._failed += 1
                         self._pending -= 1
-                    self._count_outcome("failed")
+                    self._count_outcome("failed", f.tenant)
 
     def _abort_cached(self, freq: FleetRequest,
                       err: BaseException) -> None:
@@ -503,7 +551,7 @@ class FleetRouter:
                 with self._lock:
                     self._failed += 1
                     self._pending -= 1
-                self._count_outcome("failed")
+                self._count_outcome("failed", f.tenant)
 
     def swap_weights(self, variables,
                      generation: Optional[int] = None) -> int:
@@ -611,6 +659,7 @@ class FleetRouter:
                 "completed": self._completed,
                 "failed": self._failed,
                 "shed": self._shed,
+                "quota": self._quota,
                 "hedges": self._hedges,
                 "hedge_wins": self._hedge_wins,
                 "retries": self._retries_total,
@@ -637,6 +686,8 @@ class FleetRouter:
         ]
         if self._cache is not None:
             out["cache"] = self._cache.stats()
+        if self._tenancy is not None:
+            out["tenancy"] = self._tenancy.snapshot()
         return out
 
     # -- placement ---------------------------------------------------------
@@ -719,13 +770,19 @@ class FleetRouter:
                     "retry": freq._retries,
                 })
             try:
+                # The fleet already charged the quota; the engine's
+                # tenancy (tenancy_admit=False via build_fleet) only
+                # labels metrics and packs weighted-fair.
                 if aspan is None:
-                    sub = eng.submit(freq.image, timeout=remaining)
+                    sub = eng.submit(
+                        freq.image, timeout=remaining, tenant=freq.tenant
+                    )
                 else:
                     sub = eng.submit(
                         freq.image, timeout=remaining,
                         trace_id=freq.trace_id,
                         parent_span_id=aspan.span_id,
+                        tenant=freq.tenant,
                     )
             except Overloaded:
                 if aspan is not None:
@@ -776,7 +833,7 @@ class FleetRouter:
                         self._completed += 1
                         if att.is_hedge:
                             self._hedge_wins += 1
-                    self._count_outcome("completed")
+                    self._count_outcome("completed", freq.tenant)
         # Span I/O after the latch: a file write between sub completion
         # and latching would widen the window in which the watcher sees
         # a done-but-unlatched attempt.
@@ -813,7 +870,7 @@ class FleetRouter:
                     ):
                         with self._lock:
                             self._failed += 1
-                        self._count_outcome("failed")
+                        self._count_outcome("failed", freq.tenant)
                     return
                 waits = [self.supervisor_poll]
                 if freq.deadline is not None:
@@ -869,7 +926,7 @@ class FleetRouter:
                     ):
                         with self._lock:
                             self._failed += 1
-                        self._count_outcome("failed")
+                        self._count_outcome("failed", freq.tenant)
                     return
                 if (
                     hedge_at is not None
@@ -897,7 +954,8 @@ class FleetRouter:
             with self._lock:
                 r.fail_streak = 0
             return
-        if isinstance(err, (DeadlineExceeded, Overloaded)):
+        if isinstance(err, (DeadlineExceeded, Overloaded, QuotaExceeded)):
+            # Load/budget signals, not replica faults.
             return
         if isinstance(err, EngineUnavailable):
             self._quarantine(r, f"engine unavailable: {err}")
@@ -1167,6 +1225,16 @@ def build_fleet(
     if serve_cfg is not None:
         ekw.setdefault("pack", serve_cfg.pack)
         ekw.setdefault("pack_window_s", serve_cfg.pack_window_s)
+    if "tenancy" not in fleet_kwargs and serve_cfg is not None \
+            and getattr(serve_cfg, "tenancy", None) is not None:
+        fleet_kwargs["tenancy"] = \
+            tenancy_mod.TenancyPolicy.from_config(serve_cfg.tenancy)
+    # One shared policy: the ROUTER charges the quota; engines get the
+    # same policy for tenant labels + weighted-fair packing only
+    # (tenancy_admit=False), so a request is never double-charged.
+    if fleet_kwargs.get("tenancy") is not None:
+        ekw.setdefault("tenancy", fleet_kwargs["tenancy"])
+        ekw.setdefault("tenancy_admit", False)
     if "result_cache" not in fleet_kwargs:
         cap = getattr(serve_cfg, "result_cache_capacity", 0) \
             if serve_cfg is not None else 0

@@ -44,6 +44,7 @@ from .engine import (
     DeadlineExceeded,
     EngineUnavailable,
     Overloaded,
+    QuotaExceeded,
     ServeError,
 )
 from . import result_cache as result_cache_mod
@@ -93,6 +94,7 @@ class GatewayRequest:
     attempts (the cross-host mirror of serve/fleet.py::FleetRequest)."""
 
     __slots__ = ("image", "submitted_at", "deadline", "trace_id", "span",
+                 "tenant",
                  "_lock", "_event", "_result", "_error", "_tried",
                  "_attempts_started", "_hedged", "_retries", "_on_done",
                  "_cache_key", "_cache_settle")
@@ -102,6 +104,11 @@ class GatewayRequest:
         self.image = image
         self.submitted_at = submitted_at
         self.deadline = deadline
+        # Tenant token, forwarded verbatim on every host attempt — the
+        # host fleet resolves and charges it (serve/tenancy.py), so a
+        # hedged duplicate keeps first-wins dedup across tenants without
+        # a second quota charge at pod level.
+        self.tenant: Optional[str] = None
         self.trace_id: Optional[str] = None
         self.span = None
         self._lock = threading.Lock()
@@ -340,9 +347,12 @@ class GatewayRouter:
     # -- submission --------------------------------------------------------
 
     def submit(self, image, timeout: Optional[float] = None,
-               trace_id: Optional[str] = None) -> "GatewayRequest":
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> "GatewayRequest":
         """Route one image to the pod; returns immediately.  Raises
-        :class:`EngineUnavailable` when no host is routable."""
+        :class:`EngineUnavailable` when no host is routable.  ``tenant``
+        rides every host attempt's RPC body; the host fleet resolves
+        and quota-charges it (serve/tenancy.py)."""
         if not self._started or self._stopped:
             raise EngineUnavailable("gateway not started")
         if self._draining:
@@ -353,6 +363,7 @@ class GatewayRouter:
         req = GatewayRequest(
             image, now, None if timeout is None else now + timeout
         )
+        req.tenant = tenant
         req.trace_id = trace_id
         if obs.spans_enabled():
             req.span = obs.span(
@@ -509,8 +520,12 @@ class GatewayRouter:
             remaining = req.remaining(t0)
             if remaining is not None and remaining <= 0:
                 raise DeadlineExceeded("budget exhausted before attempt")
+            # Pass the tenant only when one was resolved: tenancy-unaware
+            # host clients (older hosts, test stubs) keep working.
+            kw = {"tenant": req.tenant} if req.tenant is not None else {}
             res = h.client.infer(
                 req.image, deadline_s=remaining, trace_id=req.trace_id,
+                **kw,
             )
         except ServeError as e:
             if aspan is not None:
@@ -544,11 +559,15 @@ class GatewayRouter:
                         err: ServeError, is_hedge: bool) -> None:
         name = type(err).__name__
         host_fault = isinstance(err, (HostUnreachable, EngineUnavailable))
+        # QuotaExceeded is the CALLER's budget, not a host fault or pod
+        # pressure: it never bumps a healthy host's fail streak and is
+        # never counted as shed.
+        quota = isinstance(err, QuotaExceeded)
         with self._lock:
             h.inflight -= 1
             if isinstance(err, Overloaded):
                 self._shed += 1
-            elif not host_fault:
+            elif not host_fault and not quota:
                 h.fail_streak += 1
         self._m_requests.inc(host=h.host_id, outcome=name)
         if host_fault:
@@ -559,10 +578,14 @@ class GatewayRouter:
             return
         # Retry on a fresh host while budget and attempt slots remain.
         # DeadlineExceeded means the budget itself is gone — latch it.
+        # QuotaExceeded latches too: every host enforces the same
+        # table, so retrying a quota rejection elsewhere only burns
+        # attempts (the tenant must back off per Retry-After).
         now = self._clock()
         remaining = req.remaining(now)
         budget_ok = remaining is None or remaining > 0
-        if (not isinstance(err, DeadlineExceeded) and budget_ok
+        if (not isinstance(err, (DeadlineExceeded, QuotaExceeded))
+                and budget_ok
                 and req._attempts_started < self.max_attempts):
             view = select_host(self.views(), exclude=req.tried_hosts())
             if view is not None:

@@ -30,12 +30,20 @@ Routes
 ====================  ====  =======================================
 
 Typed serving errors cross the wire by *name*: the server maps
-``Overloaded``/``DeadlineExceeded``/``EngineUnavailable`` to
-429/504/503 with ``{"ok": false, "error": <name>}`` and the client
-re-raises the matching class, so gateway policy code handles remote
-failures with the exact same ``except`` arms as local ones.  Transport
-failures (refused, reset, timed out) raise :class:`HostUnreachable` —
-the signal that quarantines a whole host rather than one request.
+``Overloaded``/``QuotaExceeded``/``DeadlineExceeded``/
+``EngineUnavailable`` to 429/429/504/503 with ``{"ok": false, "error":
+<name>}`` and the client re-raises the matching class, so gateway
+policy code handles remote failures with the exact same ``except``
+arms as local ones.  ``QuotaExceeded`` additionally carries a
+``Retry-After`` header (and ``retry_after_s`` body field) telling the
+tenant when its token bucket refills.  Transport failures (refused,
+reset, timed out) raise :class:`HostUnreachable` — the signal that
+quarantines a whole host rather than one request.
+
+Tenancy on the wire: ``/rpc/infer`` accepts an optional ``tenant``
+token.  Unknown or absent tokens are resolved to the configured
+default tenant by the admission layer (serve/tenancy.py) — a bad token
+is never a 500.
 """
 
 from __future__ import annotations
@@ -56,6 +64,7 @@ from .engine import (
     DeadlineExceeded,
     EngineUnavailable,
     Overloaded,
+    QuotaExceeded,
     ServeError,
 )
 
@@ -77,11 +86,13 @@ class HostUnreachable(ServeError):
 # comes back as a bare ServeError.
 _ERROR_STATUS = {
     "Overloaded": 429,
+    "QuotaExceeded": 429,
     "EngineUnavailable": 503,
     "DeadlineExceeded": 504,
 }
 _ERROR_TYPES = {
     "Overloaded": Overloaded,
+    "QuotaExceeded": QuotaExceeded,
     "EngineUnavailable": EngineUnavailable,
     "DeadlineExceeded": DeadlineExceeded,
 }
@@ -222,11 +233,14 @@ class HostRpcServer:
             def log_message(self, *a) -> None:  # no stderr per request
                 pass
 
-            def _send_json(self, code: int, payload: dict) -> None:
+            def _send_json(self, code: int, payload: dict,
+                           headers: Optional[dict] = None) -> None:
                 body = (json.dumps(payload, default=str) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -238,6 +252,7 @@ class HostRpcServer:
 
             def _route(self, method: str) -> None:
                 path = self.path.split("?", 1)[0]
+                headers: Optional[dict] = None
                 try:
                     code, payload = outer._dispatch(
                         method, path, self._body if method == "POST"
@@ -247,6 +262,14 @@ class HostRpcServer:
                     name = type(e).__name__
                     code = _ERROR_STATUS.get(name, 500)
                     payload = {"ok": False, "error": name, "detail": str(e)}
+                    if isinstance(e, QuotaExceeded):
+                        # The tenant's own budget: tell it when the
+                        # bucket refills (whole seconds, floor 1).
+                        retry = max(
+                            1, int(round(getattr(e, "retry_after_s", 1.0)))
+                        )
+                        headers = {"Retry-After": retry}
+                        payload["retry_after_s"] = retry
                 except Exception as e:  # noqa: BLE001 - RPC must answer
                     code = 500
                     payload = {
@@ -257,7 +280,7 @@ class HostRpcServer:
                     route=path, outcome="ok" if code < 400 else "error"
                 )
                 try:
-                    self._send_json(code, payload)
+                    self._send_json(code, payload, headers)
                 except OSError:
                     pass  # client went away mid-response
 
@@ -314,8 +337,19 @@ class HostRpcServer:
             else np.asarray(body["image"], dtype=np.uint8)
         deadline_s = body.get("deadline_s")
         timeout = float(deadline_s) if deadline_s is not None else None
+        # Tenant token: optional, any JSON scalar tolerated (the
+        # admission layer resolves unknown/garbage to the default
+        # tenant — a bad token must never 500).  The kwarg is only
+        # passed when present so tenancy-unaware routers keep working.
+        kwargs: dict = {}
+        tenant = body.get("tenant")
+        if tenant is not None:
+            kwargs["tenant"] = (
+                tenant if isinstance(tenant, str) else str(tenant)
+            )
         req = self.router.submit(
             image, timeout=timeout, trace_id=body.get("trace_id"),
+            **kwargs,
         )
         res = req.result(timeout)
         out = encode_result(res)
@@ -433,31 +467,42 @@ class RpcClient:
                 raise ServeError(
                     f"{url}: HTTP {e.code}"
                 ) from e
-            raise _ERROR_TYPES.get(
+            err = _ERROR_TYPES.get(
                 payload.get("error", ""), ServeError
-            )(payload.get("detail", f"HTTP {e.code}")) from e
+            )(payload.get("detail", f"HTTP {e.code}"))
+            if "retry_after_s" in payload:
+                err.retry_after_s = float(payload["retry_after_s"])
+            raise err from e
         except (urllib.error.URLError, ConnectionError, TimeoutError,
                 OSError) as e:
             raise HostUnreachable(f"{url}: {e}") from e
         if not payload.get("ok", False):
-            raise _ERROR_TYPES.get(
+            err = _ERROR_TYPES.get(
                 payload.get("error", ""), ServeError
             )(payload.get("detail", "remote error"))
+            if "retry_after_s" in payload:
+                err.retry_after_s = float(payload["retry_after_s"])
+            raise err
         return payload
 
     # -- surface -----------------------------------------------------------
 
     def infer(self, image, *, deadline_s: Optional[float] = None,
-              trace_id: Optional[str] = None) -> dict:
+              trace_id: Optional[str] = None,
+              tenant: Optional[str] = None) -> dict:
         """Blocking remote inference.  ``deadline_s`` is the remaining
         budget — it rides the body (the remote deadline) *and* the
         socket timeout (plus slack so the remote's own DeadlineExceeded
-        wins the race and comes back typed)."""
+        wins the race and comes back typed).  ``tenant`` is the caller's
+        tenancy token (serve/tenancy.py); omitted means the default
+        tenant."""
         body: dict = {"image": encode_array(image)}
         if deadline_s is not None:
             body["deadline_s"] = float(deadline_s)
         if trace_id is not None:
             body["trace_id"] = trace_id
+        if tenant is not None:
+            body["tenant"] = tenant
         timeout = None if deadline_s is None else deadline_s + 2.0
         payload = self._call("POST", "/rpc/infer", body, timeout_s=timeout)
         return decode_result(payload["result"])

@@ -433,6 +433,33 @@ class TestEngine:
         with pytest.raises(EngineUnavailable):
             e.submit(_img(8, 8))
 
+    def test_death_mid_batch_fails_the_batch_not_strands_it(self):
+        # kill()'s sweep can miss a request the worker holds between the
+        # queue pop and the _inflight_reqs registration; the worker's own
+        # dead-health check must then fail the batch instead of dropping
+        # it to wait out the caller's deadline.  Simulate the missed
+        # sweep directly: declare the engine DEAD (no kill(), so nothing
+        # fails the request for us) while the runner is mid-call.
+        gate = threading.Event()
+        runner = FakeRunner(block=gate)
+        e = InferenceEngine(
+            runner, hang_timeout=300.0, watchdog_poll=0.02
+        ).start()
+        try:
+            req = e.submit(_img(8, 8))
+            deadline = time.monotonic() + 5.0
+            while e.stats()["inflight_age_s"] is None:
+                assert time.monotonic() < deadline, "batch never started"
+                time.sleep(0.005)
+            e.health.transition(health_mod.DEAD, "simulated missed sweep")
+            gate.set()
+            assert req.wait(timeout=5.0), "request stranded after death"
+            with pytest.raises(EngineUnavailable):
+                req.result()
+        finally:
+            gate.set()
+            e.stop(timeout=2)
+
     def test_results_carry_weight_generation(self):
         runner = FakeRunner()
         with InferenceEngine(runner) as e:

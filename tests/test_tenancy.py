@@ -1,0 +1,485 @@
+"""Multi-tenancy tests (serve/tenancy.py and its integration points).
+
+Covers: the tenant-table parser and identity resolution (unknown tokens
+never raise, never 500), the token-bucket quota on a fake clock
+(burst, refill, tighten/restore, Retry-After hints), bounded
+metric-label cardinality under a 1000-distinct-token hammer, the
+PackBuffer's anti-starvation aging (the regression where deadline-first
+alone starves deadline-less work forever) and weighted-fair share caps
+with priority classes, quota-vs-shed separation on the engine and the
+fleet (QuotaExceeded is typed, counted apart, and never bumps the
+autoscaler's shed signal), the wire surface (429 + Retry-After header,
+unknown/absent tenant served fine), and the control-plane side
+(tenant-filtered good_total, quota-outcome exclusion, and the
+QuotaGovernor tighten/restore loop through SLOEngine burn alerts).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import apply_overrides, get_config
+from mx_rcnn_tpu.ctrl.slo import SLO, SLOEngine, good_total, tenant_slos
+from mx_rcnn_tpu.obs.metrics import Registry, parse_labels
+from mx_rcnn_tpu.serve import (
+    FleetRouter,
+    InferenceEngine,
+    PackBuffer,
+    QuotaExceeded,
+    QuotaGovernor,
+    TenancyPolicy,
+)
+from mx_rcnn_tpu.serve.rpc import (
+    _ERROR_STATUS,
+    HostRpcServer,
+    RpcClient,
+    encode_array,
+)
+from mx_rcnn_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    OTHER_LABEL,
+    TenantSpec,
+    parse_table,
+)
+from test_batcher import _Req, PROG_A, PROG_B  # noqa: F401 — shared stubs
+from test_serve import FakeRunner, _img  # noqa: F401 — shared fakes
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _policy(table: str, clock=None, **kw) -> TenancyPolicy:
+    return TenancyPolicy(
+        parse_table(table), clock=clock or FakeClock(), **kw
+    )
+
+
+class TestTableAndIdentity:
+    def test_parse_table_full_and_bare_entries(self):
+        table = parse_table("a:weight=4,rate=50,burst=20,priority=0;b:;c")
+        assert table["a"] == TenantSpec(
+            "a", weight=4.0, rate=50.0, burst=20.0, priority=0
+        )
+        # Bare entries (with or without the colon) get stock knobs.
+        assert table["b"] == TenantSpec("b")
+        assert table["c"] == TenantSpec("c")
+
+    def test_parse_table_unknown_knob_raises(self):
+        with pytest.raises(ValueError, match="unknown knob"):
+            parse_table("a:qps=5")  # a typo'd quota must not be silent
+
+    def test_resolve_never_raises(self):
+        p = _policy("a:rate=5")
+        assert p.resolve("a") == "a"
+        assert p.resolve(None) == DEFAULT_TENANT
+        assert p.resolve("no-such-tenant") == DEFAULT_TENANT
+        assert p.resolve(12345) == DEFAULT_TENANT  # garbage JSON scalar
+
+    def test_label_folds_to_bounded_vocabulary(self):
+        p = _policy("a:;b:rate=2")
+        assert p.label("a") == "a"
+        assert p.label(None) == DEFAULT_TENANT
+        assert p.label("no-such-tenant") == OTHER_LABEL
+        assert set(p.label_values()) == {"a", "b", DEFAULT_TENANT,
+                                         OTHER_LABEL}
+
+    def test_from_config_disabled_is_none_enabled_builds(self):
+        cfg = get_config("tiny_synthetic")
+        assert TenancyPolicy.from_config(cfg.serve.tenancy) is None
+        cfg = apply_overrides(cfg, [
+            "serve.tenancy.enabled=true",
+            "serve.tenancy.table=a:rate=5,weight=2",
+        ])
+        p = TenancyPolicy.from_config(cfg.serve.tenancy)
+        assert p is not None and p.table["a"].rate == 5.0
+        assert p.default_tenant == cfg.serve.tenancy.default_tenant
+
+
+class TestTokenBucket:
+    def test_burst_then_rate_refill(self):
+        clk = FakeClock()
+        p = _policy("f:rate=2,burst=3", clock=clk)
+        assert [p.admit("f") for _ in range(4)] == [True, True, True, False]
+        clk.advance(1.0)  # 2 tokens accrue at rate=2
+        assert [p.admit("f") for _ in range(3)] == [True, True, False]
+
+    def test_unconfigured_rate_is_unlimited(self):
+        p = _policy("free:;f:rate=1,burst=1")
+        assert all(p.admit("free") for _ in range(100))
+        # Unknown tenants resolve to the default tenant: also unlimited
+        # unless the default is itself in the table with a rate.
+        assert all(p.admit(p.resolve("stranger")) for _ in range(100))
+
+    def test_tighten_scales_rate_and_restore_undoes(self):
+        clk = FakeClock()
+        p = _policy("f:rate=2,burst=1", clock=clk, tighten_factor=0.25)
+        assert p.admit("f") and not p.admit("f")  # burst spent
+        assert p.tighten("f")
+        assert not p.tighten("f")  # idempotent: factor unchanged
+        clk.advance(1.0)  # 0.5 tokens at the tightened rate of 0.5/s
+        assert not p.admit("f")
+        clk.advance(1.0)  # 1.0 token now
+        assert p.admit("f")
+        assert p.retry_after_s("f") == pytest.approx(2.0)  # 1/(2*0.25)
+        assert p.snapshot()["f"]["factor"] == 0.25
+        assert p.restore("f")
+        assert not p.restore("f")
+        assert p.retry_after_s("f") == pytest.approx(1.0)  # floor
+        assert p.snapshot()["f"]["factor"] == 1.0
+
+    def test_tighten_unknown_tenant_is_a_noop(self):
+        p = _policy("f:rate=1")
+        assert not p.tighten("no-such") and not p.restore("no-such")
+
+
+class TestLabelCardinality:
+    def test_thousand_distinct_tokens_stay_bounded(self):
+        p = _policy("a:;b:rate=2")
+        reg = Registry()
+        c = reg.counter("serve_requests_total", "admitted")
+        for i in range(1000):
+            c.inc(tenant=p.label(f"token-{i}"))
+        series = reg.snapshot()["serve_requests_total"]
+        assert len(series) == 1  # every stranger folded to one series
+        assert set(series) == {f'{{tenant="{OTHER_LABEL}"}}'}
+        assert len(series) <= len(p.table) + 2  # the documented bound
+
+    def test_fleet_metrics_only_carry_vocabulary_labels(self):
+        from mx_rcnn_tpu import obs
+
+        p = _policy("a:")
+        fleet, _ = _tenant_fleet(p)
+        with fleet:
+            reqs = [
+                fleet.submit(_img(8, 8), timeout=5, tenant=f"tok{i}")
+                for i in range(10)
+            ]
+            for r in reqs:
+                r.result(timeout=5)
+        vocab = set(p.label_values())
+        series = obs.registry().snapshot().get("fleet_requests_total", {})
+        seen = {
+            parse_labels(k).get("tenant")
+            for k in series
+            if "tenant=" in k
+        }
+        assert seen and seen <= vocab, (seen, vocab)
+
+
+class TestAntiStarvationAging:
+    def test_starved_request_leads_after_max_passovers(self):
+        # THE regression: with deadline-first alone, the deadline-less
+        # program-B request below is passed over by every pack forever
+        # while deadlined program-A work keeps arriving.  Aging promotes
+        # it to lead after max_passovers consecutive passes.
+        buf = PackBuffer(max_passovers=2)
+        starved = _Req(plan=PROG_B, enqueued_at=0.0)
+        buf.add(starved)
+        for i in range(2):  # a fresh pair of urgent arrivals per pack
+            buf.add(_Req(plan=PROG_A, deadline=1.0 + i, enqueued_at=1.0 + i))
+            buf.add(_Req(plan=PROG_A, deadline=1.5 + i, enqueued_at=1.5 + i))
+            assert starved not in buf.take(2)  # deadline-first leads
+        buf.add(_Req(plan=PROG_A, deadline=9.0, enqueued_at=9.0))
+        buf.add(_Req(plan=PROG_A, deadline=9.5, enqueued_at=9.5))
+        pack3 = buf.take(2)
+        assert pack3 == [starved], pack3  # aged out of starvation
+
+    def test_bounded_delay_under_constant_pressure(self):
+        # Any buffered request reaches the device within
+        # max_passovers + 1 packs of arriving, even against an endless
+        # stream of more-urgent arrivals on another program.
+        buf = PackBuffer(max_passovers=3)
+        victim = _Req(plan=PROG_B, enqueued_at=0.0)
+        buf.add(victim)
+        packs_until_served = None
+        for pack_i in range(10):
+            buf.add(_Req(plan=PROG_A, deadline=float(pack_i),
+                         enqueued_at=float(pack_i)))
+            buf.add(_Req(plan=PROG_A, deadline=float(pack_i),
+                         enqueued_at=float(pack_i) + 0.5))
+            taken = buf.take(2)
+            if victim in taken:
+                packs_until_served = pack_i + 1
+                break
+        assert packs_until_served is not None, "victim starved forever"
+        assert packs_until_served <= 4  # max_passovers + 1
+
+    def test_taken_requests_forget_their_age(self):
+        buf = PackBuffer(max_passovers=2)
+        a = _Req(plan=PROG_A, enqueued_at=0.0)
+        buf.add(a)
+        buf.add(_Req(plan=PROG_B, deadline=1.0, enqueued_at=1.0))
+        buf.take(2)  # B leads; a passed over once
+        assert buf.take(2) == [a]
+        buf.add(a)  # re-admitted (hedge-style): age must restart at 0
+        buf.add(_Req(plan=PROG_B, deadline=2.0, enqueued_at=2.0))
+        assert a not in buf.take(2)
+
+
+class _TReq(_Req):
+    """Planned-request stub with a tenant token."""
+
+    def __init__(self, tenant, **kw):
+        super().__init__(**kw)
+        self.tenant = tenant
+
+
+class TestWeightedFairPacking:
+    def test_share_cap_bounds_the_flooder(self):
+        p = _policy("heavy:weight=3;flood:weight=1")
+        buf = PackBuffer(tenancy=p)
+        floods = [
+            _TReq("flood", plan=PROG_A, enqueued_at=float(i))
+            for i in range(4)
+        ]
+        heavies = [
+            _TReq("heavy", plan=PROG_A, enqueued_at=10.0 + i)
+            for i in range(3)
+        ]
+        for r in floods + heavies:
+            buf.add(r)
+        pack = buf.take(4)
+        # batch_size 4 split 3:1 by weight — the flooder's four earlier
+        # arrivals cannot crowd the heavy tenant out of the call.
+        assert sum(1 for r in pack if r.tenant == "flood") == 1
+        assert sum(1 for r in pack if r.tenant == "heavy") == 3
+
+    def test_caps_are_work_conserving(self):
+        p = _policy("heavy:weight=3;flood:weight=1")
+        buf = PackBuffer(tenancy=p)
+        for i in range(4):  # only the flooder has work buffered
+            buf.add(_TReq("flood", plan=PROG_A, enqueued_at=float(i)))
+        assert len(buf.take(4)) == 4  # fairness never costs occupancy
+
+    def test_lower_priority_class_drains_first(self):
+        p = _policy("paid:priority=0;free:priority=1")
+        buf = PackBuffer(tenancy=p)
+        free_urgent = _TReq("free", plan=PROG_A, deadline=1.0,
+                            enqueued_at=0.0)
+        paid_lazy = _TReq("paid", plan=PROG_B, enqueued_at=5.0)
+        buf.add(free_urgent)
+        buf.add(paid_lazy)
+        assert buf.take(1) == [paid_lazy]  # class 0 beats urgency
+
+    def test_untenanted_requests_fold_to_default(self):
+        p = _policy("a:weight=2")
+        buf = PackBuffer(tenancy=p)
+        plain = [_Req(plan=PROG_A, enqueued_at=float(i)) for i in range(3)]
+        for r in plain:
+            buf.add(r)
+        assert buf.take(3) == plain  # single-tenant path: exact FIFO
+
+
+def _tenant_fleet(policy, n=1, **kw):
+    def factory(rid):
+        # The router charges the quota; engines share the policy for
+        # labels + fair packing only — mirrors serve.build_fleet.
+        return InferenceEngine(
+            FakeRunner(), replica_id=rid, tenancy=policy,
+            tenancy_admit=False,
+        )
+
+    kw.setdefault("supervisor_poll", 0.02)
+    return FleetRouter(factory, n, tenancy=policy, **kw), policy
+
+
+class TestQuotaIsNotShed:
+    def test_standalone_engine_enforces_quota(self):
+        p = _policy("f:rate=1,burst=1")
+        with InferenceEngine(FakeRunner(), tenancy=p) as e:
+            assert e.submit(_img(8, 8), tenant="f").result()["level"]
+            with pytest.raises(QuotaExceeded) as ei:
+                e.submit(_img(8, 8), tenant="f")
+            assert ei.value.retry_after_s == pytest.approx(1.0)
+            # Unknown token folds to the (unlimited) default tenant.
+            assert e.submit(_img(8, 8), tenant="stranger").result()
+
+    def test_engine_with_admit_off_never_rejects(self):
+        p = _policy("f:rate=1,burst=1")
+        with InferenceEngine(
+            FakeRunner(), tenancy=p, tenancy_admit=False
+        ) as e:
+            for _ in range(5):
+                assert e.submit(_img(8, 8), tenant="f").result()
+
+    def test_fleet_counts_quota_apart_from_shed(self):
+        p = _policy("flood:rate=1,burst=1")  # fixed clock: no refill
+        fleet, _ = _tenant_fleet(p)
+        with fleet:
+            ok = fleet.submit(_img(8, 8), timeout=5, tenant="flood")
+            rejected = 0
+            for _ in range(3):
+                with pytest.raises(QuotaExceeded) as ei:
+                    fleet.submit(_img(8, 8), timeout=5, tenant="flood")
+                assert ei.value.retry_after_s >= 1.0
+                rejected += 1
+            ok.result(timeout=5)
+            s = fleet.stats()
+        assert s["quota"] == rejected == 3
+        assert s["shed"] == 0  # the autoscaler's signal stays clean
+        assert s["failed"] == 0 and s["completed"] == 1
+        assert s["submitted"] == 4  # quota rejections are still requests
+        assert s["tenancy"]["flood"]["rate"] == 1.0
+
+    def test_quota_exceeded_is_not_overloaded(self):
+        from mx_rcnn_tpu.serve import Overloaded, ServeError
+
+        assert issubclass(QuotaExceeded, ServeError)
+        assert not issubclass(QuotaExceeded, Overloaded)
+        assert not issubclass(Overloaded, QuotaExceeded)
+
+
+class _TenantFleet:
+    """FleetRouter-shaped stub: admission via a real TenancyPolicy."""
+
+    def __init__(self, policy):
+        self.policy = policy
+        self.generation = 0
+        self.seen = []
+
+    def submit(self, image, timeout=None, trace_id=None, tenant=None):
+        tenant = self.policy.resolve(tenant)
+        if not self.policy.admit(tenant):
+            err = QuotaExceeded(f"tenant {tenant!r} over quota")
+            err.retry_after_s = self.policy.retry_after_s(tenant)
+            raise err
+        self.seen.append(tenant)
+
+        class _Done:
+            def result(self, timeout=None):
+                return {"boxes": np.zeros((1, 4), np.float32),
+                        "generation": 0}
+
+        return _Done()
+
+    def stats(self):
+        return {"replicas": 1, "pending": 0, "generation": 0,
+                "draining": False}
+
+
+@pytest.fixture
+def tenant_rpc():
+    fleet = _TenantFleet(_policy("acme:;flood:rate=1,burst=1"))
+    server = HostRpcServer(fleet, "hostT", port=0).start()
+    client = RpcClient(server.addr)
+    yield fleet, server, client
+    server.close()
+
+
+class TestWireSurface:
+    def test_wire_vocab_maps_quota_to_429(self):
+        assert _ERROR_STATUS["QuotaExceeded"] == 429
+
+    def test_tenant_crosses_the_wire(self, tenant_rpc):
+        fleet, _, client = tenant_rpc
+        client.infer(np.zeros((4, 4, 3), np.uint8), tenant="acme")
+        assert fleet.seen == ["acme"]
+
+    def test_unknown_and_absent_tenant_never_500(self, tenant_rpc):
+        fleet, _, client = tenant_rpc
+        client.infer(np.zeros((4, 4, 3), np.uint8), tenant="no-such")
+        client.infer(np.zeros((4, 4, 3), np.uint8))  # absent
+        assert fleet.seen == [DEFAULT_TENANT, DEFAULT_TENANT]
+
+    def test_quota_is_429_with_retry_after_header(self, tenant_rpc):
+        fleet, server, client = tenant_rpc
+        client.infer(np.zeros((4, 4, 3), np.uint8), tenant="flood")
+        with pytest.raises(QuotaExceeded) as ei:
+            client.infer(np.zeros((4, 4, 3), np.uint8), tenant="flood")
+        assert ei.value.retry_after_s >= 1.0
+        # The raw HTTP response carries the header, not just the body
+        # field — off-the-shelf clients back off without our codec.
+        body = json.dumps({
+            "image": encode_array(np.zeros((4, 4, 3), np.uint8)),
+            "tenant": "flood",
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{server.addr}/rpc/infer", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as hei:
+            urllib.request.urlopen(req, timeout=5)
+        assert hei.value.code == 429
+        assert int(hei.value.headers["Retry-After"]) >= 1
+
+
+def _avail_snapshot(**series):
+    """{'completed_a': 8, ...} -> a fleet_requests_total snapshot."""
+    out = {}
+    for key, v in series.items():
+        outcome, _, tenant = key.rpartition("_")
+        out[f'{{outcome="{outcome}",tenant="{tenant}"}}'] = float(v)
+    return {"fleet_requests_total": out}
+
+
+class TestTenantSLOs:
+    def test_good_total_filters_by_tenant(self):
+        snap = _avail_snapshot(
+            completed_a=8, shed_a=2, quota_a=5, completed_b=3, failed_b=1,
+        )
+        slo_a = SLO("availability[a]", target=0.9, tenant="a")
+        assert good_total(slo_a, snap) == (8.0, 10.0)
+        slo_b = SLO("availability[b]", target=0.9, tenant="b")
+        assert good_total(slo_b, snap) == (3.0, 4.0)
+
+    def test_quota_outcome_burns_no_budget(self):
+        # A quota-capped flooder is a contractual 429, not fleet
+        # unavailability: excluded from the fleet-wide total too.
+        snap = _avail_snapshot(completed_a=8, shed_a=2, quota_a=100)
+        fleet_wide = SLO("availability", target=0.9)
+        assert good_total(fleet_wide, snap) == (8.0, 10.0)
+        scoped = SLO("availability[a]", target=0.9, tenant="a")
+        assert good_total(scoped, snap) == (8.0, 10.0)
+
+    def test_tenant_slos_name_and_scope(self):
+        cfg = get_config("tiny_synthetic")
+        slos = tenant_slos(cfg.ctrl, ("a", "b"))
+        assert [s.name for s in slos] == [
+            "availability[a]", "latency[a]",
+            "availability[b]", "latency[b]",
+        ]
+        assert all(s.tenant in ("a", "b") for s in slos)
+
+    def test_burn_alert_drives_quota_governor(self):
+        p = _policy("a:rate=10,burst=5", tighten_factor=0.25)
+        gov = QuotaGovernor(p)
+        slo = SLO("availability[a]", target=0.5, tenant="a")
+        eng = SLOEngine(
+            (slo,), registry=Registry(), fast_s=1.0, slow_s=1.0,
+            burn_factor=1.0, on_alert=gov.on_alert,
+        )
+        eng.observe(t=0.0, snapshot=_avail_snapshot(completed_a=0))
+        # 10 failures, 0 good: burn 2.0 over both windows -> fires.
+        eng.observe(t=1.0, snapshot=_avail_snapshot(failed_a=10))
+        assert gov.actions == [("tighten", "a")]
+        assert p.snapshot()["a"]["factor"] == 0.25
+        # Fast window recovers (all-good delta) -> clears -> restore.
+        eng.observe(t=3.0, snapshot=_avail_snapshot(
+            failed_a=10, completed_a=90,
+        ))
+        assert gov.actions == [("tighten", "a"), ("restore", "a")]
+        assert p.snapshot()["a"]["factor"] == 1.0
+
+    def test_fleet_wide_burn_never_touches_quotas(self):
+        p = _policy("a:rate=10,burst=5")
+        gov = QuotaGovernor(p)
+        slo = SLO("availability", target=0.5)  # no tenant scope
+        eng = SLOEngine(
+            (slo,), registry=Registry(), fast_s=1.0, slow_s=1.0,
+            burn_factor=1.0, on_alert=gov.on_alert,
+        )
+        eng.observe(t=0.0, snapshot=_avail_snapshot(completed_a=0))
+        eng.observe(t=1.0, snapshot=_avail_snapshot(failed_a=10))
+        assert gov.actions == []
+        assert p.snapshot()["a"]["factor"] == 1.0

@@ -32,6 +32,19 @@ BENCH_serving line always reports batch occupancy (mean + p50 over
 device calls) and ``sustained_qps_per_replica``; ``--assert-occupancy``
 gates on the mean.
 
+``--tenants 'victim:weight=4,qps=5;flood:rate=6,burst=4,qps=30,role=flooder'``
+runs one open-loop schedule PER TENANT: each entry names a tenant, its
+offered ``qps`` (plus an optional per-tenant ``profile``), its policy
+knobs (``weight/rate/burst/priority`` — forwarded into
+``serve.tenancy.table`` when driving a local fleet), and an optional
+``role=flooder`` marker.  Every request carries its tenant token;
+``QuotaExceeded`` rejections are counted per tenant as ``quota``
+(distinct from ``shed``), and the BENCH line gains a per-tenant table.
+``--assert-tenant-isolation FACTOR`` runs a flooder-free baseline phase
+first and exits nonzero unless every non-flooder tenant's p99 in the
+full mix stays within FACTOR of its solo baseline (noisy-neighbor
+isolation, docs/serving.md).
+
 ``--targets hostA:port,hostB:port`` swaps the local fleet for an
 in-process :class:`~mx_rcnn_tpu.serve.gateway.GatewayRouter` over REAL
 host processes (tools/serve_host.py), and ``--gateway URL`` drives a
@@ -113,6 +126,71 @@ def make_profile(
     raise ValueError(f"unknown profile {name!r} (want one of {PROFILES})")
 
 
+_TENANT_POLICY_KEYS = ("weight", "rate", "burst", "priority")
+
+
+def parse_tenant_load_spec(spec: str) -> list[dict]:
+    """``--tenants`` entries: ``name:k=v,...;name2:...`` where the keys
+    are the ``serve.tenancy`` policy knobs plus the load-side ``qps``,
+    ``profile`` and ``role`` (``role=flooder`` marks the adversary the
+    isolation gate excludes from its baseline).  Shared with
+    tools/soak.py so both rehearse the same tenant mixes.
+    """
+    out: list[dict] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, kvs = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"tenant entry missing a name: {part!r}")
+        ent = {"name": name, "qps": None, "profile": "constant",
+               "role": "normal", "policy": {}}
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            key, sep, val = kv.partition("=")
+            key, val = key.strip(), val.strip()
+            if not sep:
+                raise ValueError(f"tenant {name!r}: malformed knob {kv!r}")
+            if key == "qps":
+                ent["qps"] = float(val)
+            elif key == "profile":
+                if val not in PROFILES:
+                    raise ValueError(
+                        f"tenant {name!r}: unknown profile {val!r}"
+                    )
+                ent["profile"] = val
+            elif key == "role":
+                ent["role"] = val
+            elif key in _TENANT_POLICY_KEYS:
+                ent["policy"][key] = val
+            else:
+                raise ValueError(
+                    f"tenant {name!r}: unknown knob {key!r} (expected "
+                    f"qps/profile/role or one of {_TENANT_POLICY_KEYS})"
+                )
+        out.append(ent)
+    if not out:
+        raise ValueError("empty --tenants spec")
+    if len({e["name"] for e in out}) != len(out):
+        raise ValueError("duplicate tenant name in --tenants spec")
+    return out
+
+
+def tenant_table_string(specs: list[dict]) -> str:
+    """Rebuild the ``serve.tenancy.table`` string from parsed entries
+    (policy knobs only — qps/profile/role are load-side)."""
+    return ";".join(
+        e["name"] + ":" + ",".join(
+            f"{k}={v}" for k, v in e["policy"].items()
+        )
+        for e in specs
+    )
+
+
 def _hermetic_cpu(n_devices: int) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
@@ -159,13 +237,15 @@ class _RemoteGateway:
 
         self.client = RpcClient(url)
 
-    def submit(self, image, timeout=None, trace_id=None) -> _RemoteFuture:
+    def submit(self, image, timeout=None, trace_id=None,
+               tenant=None) -> _RemoteFuture:
         fut = _RemoteFuture()
 
         def run() -> None:
             try:
                 fut._result = self.client.infer(
-                    image, deadline_s=timeout, trace_id=trace_id
+                    image, deadline_s=timeout, trace_id=trace_id,
+                    tenant=tenant,
                 )
             except BaseException as e:  # noqa: BLE001 - carried to result()
                 fut._error = e
@@ -224,9 +304,11 @@ def run_bench(args: argparse.Namespace) -> dict:
     import numpy as np
 
     import jax
-    from mx_rcnn_tpu.config import get_config
+    from mx_rcnn_tpu.config import apply_overrides, get_config
     from mx_rcnn_tpu.detection import TwoStageDetector, init_detector
-    from mx_rcnn_tpu.serve import Overloaded, ServeError, build_fleet
+    from mx_rcnn_tpu.serve import (
+        Overloaded, QuotaExceeded, ServeError, build_fleet,
+    )
 
     from mx_rcnn_tpu import obs
 
@@ -241,6 +323,14 @@ def run_bench(args: argparse.Namespace) -> dict:
               f"metrics_port={obs.metrics_port()}", file=sys.stderr)
 
     cfg = get_config(args.config)
+    tenant_specs = getattr(args, "_tenant_specs", None)
+    if tenant_specs:
+        # A local fleet enforces the tenant table itself; fabric modes
+        # only carry the tokens (the remote hosts own their policy).
+        cfg = apply_overrides(cfg, [
+            "serve.tenancy.enabled=true",
+            f"serve.tenancy.table={tenant_table_string(tenant_specs)}",
+        ])
     fleet, hosts = _build_driver(args, cfg)
     if fleet is None:
         variables = init_detector(
@@ -288,25 +378,46 @@ def run_bench(args: argparse.Namespace) -> dict:
 
     lock = threading.Lock()
     latencies: list[float] = []
-    submitted = shed = failed = 0
+    submitted = shed = quota = failed = 0
     pending: list = []
+    tstats: dict[str, dict] = {
+        e["name"]: {"submitted": 0, "shed": 0, "quota": 0, "failed": 0,
+                    "lat": []}
+        for e in (tenant_specs or [])
+    }
 
-    def collect(freq, t_submit: float) -> None:
-        nonlocal shed, failed
+    def collect(freq, t_submit: float, tenant: str | None = None) -> None:
+        nonlocal shed, quota, failed
+        ts = tstats.get(tenant)
         try:
             freq.result(timeout=args.deadline + 60.0)
+        except QuotaExceeded:
+            # The tenant's own budget, not fleet pressure — kept apart
+            # from shed on both the global and per-tenant rows.
+            with lock:
+                quota += 1
+                if ts is not None:
+                    ts["quota"] += 1
+            return
         except Overloaded:
             # Fabric modes surface admission-control shedding at result
             # time (the remote 429 comes back on the response path).
             with lock:
                 shed += 1
+                if ts is not None:
+                    ts["shed"] += 1
             return
         except ServeError:
             with lock:
                 failed += 1
+                if ts is not None:
+                    ts["failed"] += 1
             return
+        lat = time.monotonic() - t_submit
         with lock:
-            latencies.append(time.monotonic() - t_submit)
+            latencies.append(lat)
+            if ts is not None:
+                ts["lat"].append(lat)
 
     killed_rid = None
     if args.clients > 0:
@@ -376,6 +487,87 @@ def run_bench(args: argparse.Namespace) -> dict:
             t.join(timeout=args.duration + args.deadline + 120.0)
         return _finish(args, fleet, latencies, submitted, shed, failed,
                        killed_rid, obs_on)
+    if tenant_specs:
+        # One open-loop schedule per tenant: each tenant's arrivals are
+        # clocked independently at its own qps/profile, so the flooder
+        # falling behind (or bouncing off its quota) never slows the
+        # victims' offered load.
+        t0 = time.monotonic()
+        deadline_wall = t0 + args.duration
+        n_tenants = len(tenant_specs)
+
+        def tenant_loop(ent: dict) -> None:
+            nonlocal submitted, shed, quota, failed
+            name = ent["name"]
+            ts = tstats[name]
+            rate = make_profile(
+                ent["profile"],
+                ent["qps"] if ent["qps"] else max(args.qps / n_tenants, 0.1),
+                amplitude=args.amplitude, period_s=args.period,
+                spike_factor=args.spike_factor, duty=args.duty,
+            )
+            next_at = t0
+            sent = 0
+            while True:
+                now = time.monotonic()
+                if now >= deadline_wall:
+                    return
+                if now < next_at:
+                    time.sleep(min(next_at - now, 0.05))
+                    continue
+                next_at += 1.0 / rate(now - t0)
+                trace_id = obs.new_trace_id() if obs_on else None
+                sent += 1
+                try:
+                    freq = fleet.submit(
+                        pick_image(sent, sent), timeout=args.deadline,
+                        trace_id=trace_id, tenant=name,
+                    )
+                except QuotaExceeded:
+                    with lock:
+                        submitted += 1
+                        quota += 1
+                        ts["submitted"] += 1
+                        ts["quota"] += 1
+                    continue
+                except Overloaded:
+                    with lock:
+                        submitted += 1
+                        shed += 1
+                        ts["submitted"] += 1
+                        ts["shed"] += 1
+                    continue
+                except ServeError as e:
+                    with lock:
+                        submitted += 1
+                        failed += 1
+                        ts["submitted"] += 1
+                        ts["failed"] += 1
+                    print(f"[loadgen] {name}: submit failed: {e}",
+                          file=sys.stderr)
+                    continue
+                with lock:
+                    submitted += 1
+                    ts["submitted"] += 1
+                t = threading.Thread(
+                    target=collect, args=(freq, now, name), daemon=True
+                )
+                t.start()
+                pending.append(t)
+
+        loops = [
+            threading.Thread(target=tenant_loop, args=(e,), daemon=True)
+            for e in tenant_specs
+        ]
+        for t in loops:
+            t.start()
+        for t in loops:
+            t.join(timeout=args.duration + 120.0)
+        for t in list(pending):
+            t.join(timeout=args.deadline + 120.0)
+        return _finish(args, fleet, latencies, submitted, shed, failed,
+                       killed_rid, obs_on, quota=quota, tstats=tstats,
+                       tenant_specs=tenant_specs)
     rate = make_profile(
         args.profile, args.qps,
         amplitude=args.amplitude, period_s=args.period,
@@ -410,6 +602,11 @@ def run_bench(args: argparse.Namespace) -> dict:
         try:
             freq = fleet.submit(pick_image(submitted, submitted),
                                 timeout=args.deadline, trace_id=trace_id)
+        except QuotaExceeded:
+            with lock:
+                submitted += 1
+                quota += 1
+            continue
         except Overloaded:
             with lock:
                 submitted += 1
@@ -430,7 +627,7 @@ def run_bench(args: argparse.Namespace) -> dict:
     for t in pending:
         t.join(timeout=args.deadline + 120.0)
     return _finish(args, fleet, latencies, submitted, shed, failed,
-                   killed_rid, obs_on)
+                   killed_rid, obs_on, quota=quota)
 
 
 def _occupancy_summary() -> dict:
@@ -462,7 +659,7 @@ def _occupancy_summary() -> dict:
 
 
 def _finish(args, fleet, latencies, submitted, shed, failed, killed_rid,
-            obs_on) -> dict:
+            obs_on, quota=0, tstats=None, tenant_specs=None) -> dict:
     from mx_rcnn_tpu import obs
 
     stats = fleet.stats()
@@ -489,6 +686,7 @@ def _finish(args, fleet, latencies, submitted, shed, failed, killed_rid,
         "submitted": submitted,
         "completed": len(latencies),
         "shed": shed,
+        "quota": quota,
         "failed": failed,
         "sustained_qps_per_replica": round(
             len(latencies) / args.duration / max(args.replicas, 1), 3
@@ -506,6 +704,24 @@ def _finish(args, fleet, latencies, submitted, shed, failed, killed_rid,
         "retries": stats["retries"],
         "generation": stats["generation"],
     }
+    if tstats is not None and tenant_specs is not None:
+        roles = {e["name"]: e["role"] for e in tenant_specs}
+        tenants = {}
+        for name, ts in tstats.items():
+            lat = sorted(ts["lat"])
+            tenants[name] = {
+                "role": roles.get(name, "normal"),
+                "submitted": ts["submitted"],
+                "completed": len(lat),
+                "shed": ts["shed"],
+                "quota": ts["quota"],
+                "failed": ts["failed"],
+                "p50_s": round(_percentile(lat, 0.50), 4),
+                "p99_s": round(_percentile(lat, 0.99), 4),
+            }
+        rec["tenants"] = tenants
+        if isinstance(stats.get("tenancy"), dict):
+            rec["tenancy"] = stats["tenancy"]
     if obs_on:
         port = obs.metrics_port()
         if port is not None:
@@ -585,6 +801,16 @@ def main(argv=None) -> int:
     p.add_argument("--dup-frac", type=float, default=0.0,
                    help="fraction of arrivals that re-send one hot image "
                         "(duplicate-heavy traffic for the result cache)")
+    p.add_argument("--tenants", default="",
+                   help="per-tenant open-loop mix: 'name:qps=5,weight=4;"
+                        "flood:qps=30,rate=6,role=flooder' — policy "
+                        "knobs feed serve.tenancy.table on a local "
+                        "fleet; see docs/serving.md")
+    p.add_argument("--assert-tenant-isolation", type=float, default=None,
+                   help="with --tenants: run a flooder-free baseline "
+                        "first and exit nonzero unless every non-flooder "
+                        "tenant's p99 in the full mix is within this "
+                        "factor of its solo baseline")
     p.add_argument("--assert-p50", type=float, default=None,
                    help="exit nonzero unless p50 latency (s) is under "
                         "this bound")
@@ -607,9 +833,51 @@ def main(argv=None) -> int:
     if args.kill_one and (args.targets or args.gateway):
         p.error("--kill-one drives a LOCAL fleet; use tools/chaos.py "
                 "host_kill for fabric-level failure injection")
+    tenant_specs = None
+    if args.tenants:
+        if args.clients > 0 or args.kill_one:
+            p.error("--tenants is an open-loop multi-tenant mix; it "
+                    "composes with neither --clients nor --kill-one")
+        try:
+            tenant_specs = parse_tenant_load_spec(args.tenants)
+        except ValueError as e:
+            p.error(str(e))
+        args._tenant_specs = tenant_specs
+    if args.assert_tenant_isolation is not None:
+        if not tenant_specs:
+            p.error("--assert-tenant-isolation requires --tenants")
+        if all(e["role"] != "flooder" for e in tenant_specs):
+            p.error("--assert-tenant-isolation needs a role=flooder "
+                    "tenant to remove in the baseline phase")
     _hermetic_cpu(args.replicas)
 
+    baseline = None
+    if args.assert_tenant_isolation is not None:
+        # Phase A: the same victims at the same rates, flooder removed
+        # (and no obs plane — one journal per process).  Its record goes
+        # to stderr only; the BENCH contract stays one-stdout-line.
+        import copy
+
+        base_args = copy.copy(args)
+        base_args._tenant_specs = [
+            e for e in tenant_specs if e["role"] != "flooder"
+        ]
+        base_args.obs_dir = None
+        print("[loadgen] isolation baseline: flooder-free phase...",
+              file=sys.stderr)
+        baseline = run_bench(base_args)
+        print(f"[loadgen] baseline record: {json.dumps(baseline)}",
+              file=sys.stderr)
+
     rec = run_bench(args)
+    if baseline is not None:
+        rec["isolation"] = {
+            "factor": args.assert_tenant_isolation,
+            "baseline_p99_s": {
+                name: t["p99_s"]
+                for name, t in baseline["tenants"].items()
+            },
+        }
     print(json.dumps(rec))
 
     ok = True
@@ -638,6 +906,28 @@ def main(argv=None) -> int:
             print(f"[loadgen] FAIL: mean batch occupancy {mean_occ} < "
                   f"bound {args.assert_occupancy}", file=sys.stderr)
             ok = False
+    if args.assert_tenant_isolation is not None:
+        factor = args.assert_tenant_isolation
+        for name, t in rec["tenants"].items():
+            if t["role"] == "flooder":
+                continue
+            if t["completed"] == 0:
+                print(f"[loadgen] FAIL: tenant {name} completed nothing "
+                      f"in the mix phase", file=sys.stderr)
+                ok = False
+                continue
+            solo = rec["isolation"]["baseline_p99_s"].get(name)
+            mix = t["p99_s"]
+            # The 50 ms floor keeps sub-tick solo baselines from turning
+            # scheduler noise into a flaky gate.
+            if solo is None or not mix <= factor * max(solo, 0.05):
+                print(f"[loadgen] FAIL: tenant {name} p99 {mix}s vs "
+                      f"flooder-free baseline {solo}s exceeds factor "
+                      f"{factor}", file=sys.stderr)
+                ok = False
+        if ok:
+            print(f"[loadgen] tenant isolation HELD (factor {factor})",
+                  file=sys.stderr)
     return 0 if ok else 1
 
 

@@ -38,6 +38,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from mx_rcnn_tpu.obs.metrics import parse_labels  # noqa: E402
+
 # Event kinds that mark state changes in an incident, in no particular
 # order — the TIMELINE order comes from the journal, these only filter
 # routine chatter (metrics_flush, shed) out of it.
@@ -56,6 +58,7 @@ INCIDENT_KINDS = frozenset({
     "gateway_weight_roll",
     "deploy_candidate", "deploy_shadow_start", "deploy_shadow_verdict",
     "deploy_promote", "deploy_reject", "deploy_rollback", "deploy_resume",
+    "tenant_quota_tightened", "tenant_quota_restored",
 })
 
 
@@ -142,6 +145,59 @@ def _pack_section(journal: list[dict]) -> dict:
         if vals:
             out["serve_cache_size"] = vals[-1]
     return out
+
+
+def _tenant_section(journal: list[dict], t0: float) -> dict:
+    """Per-tenant story when multi-tenancy ran (docs/serving.md): request
+    outcomes from the ``tenant``-labelled ``fleet_requests_total`` rows of
+    the last ``metrics_flush`` snapshot, quota rejections from
+    ``serve_quota_exceeded_total``, and the per-tenant burn/governor
+    timeline (burn transitions on tenant-scoped SLOs plus quota
+    tighten/restore actions).  Empty when the run had no tenancy — the
+    metrics carry no ``tenant`` label then, by design."""
+    snap: dict = {}
+    for rec in journal:
+        if rec.get("kind") == "metrics_flush":
+            s = (rec.get("payload") or {}).get("snapshot") or {}
+            if s:
+                snap = s  # cumulative series: the LAST flush wins
+    tenants: dict[str, dict] = {}
+
+    def ent(name: str) -> dict:
+        return tenants.setdefault(name, {
+            "requests": {}, "quota_rejections": 0, "timeline": [],
+        })
+
+    series = snap.get("fleet_requests_total")
+    if isinstance(series, dict):
+        for key, v in series.items():
+            lbl = parse_labels(key)
+            name = lbl.get("tenant")
+            if not name or not isinstance(v, (int, float)):
+                continue
+            outcomes = ent(name)["requests"]
+            outcome = lbl.get("outcome", "?")
+            outcomes[outcome] = outcomes.get(outcome, 0) + int(round(v))
+    series = snap.get("serve_quota_exceeded_total")
+    if isinstance(series, dict):
+        for key, v in series.items():
+            name = parse_labels(key).get("tenant")
+            if name and isinstance(v, (int, float)):
+                ent(name)["quota_rejections"] += int(round(v))
+    for rec in journal:
+        kind = rec.get("kind")
+        if kind not in ("slo_burn_start", "slo_burn_stop",
+                        "tenant_quota_tightened", "tenant_quota_restored"):
+            continue
+        payload = rec.get("payload") or {}
+        name = payload.get("tenant")
+        if not name:
+            continue  # fleet-wide burn: not one tenant's story
+        ent(name)["timeline"].append({
+            "t_s": round(rec.get("ts", t0) - t0, 3), "kind": kind,
+            **{k: v for k, v in payload.items() if k != "tenant"},
+        })
+    return tenants
 
 
 def _read_jsonl(path: str) -> list[dict]:
@@ -232,6 +288,7 @@ def build_report(
         "events_by_kind": dict(sorted(events_by_kind.items())),
         "incident_timeline": timeline,
         "slo": _slo_section(journal, t0),
+        "tenants": _tenant_section(journal, t0),
         "data_plane": _pack_section(journal),
         "spans": {
             "count": len(spans),

@@ -17,6 +17,14 @@ One process runs, concurrently:
   load), and optionally (``--data-chaos``) a data-path chaos scenario
   (cache corruption + decode-worker kill) as concurrent subprocesses,
   rehearsing the input service failing while serving burns;
+* **adversarial tenancy** (``--tenants``) — the mix becomes one
+  open-loop schedule per tenant (flooder/bursty/latency-sensitive —
+  same spec as tools/loadgen.py), the fleet enforces per-tenant
+  token-bucket quotas (serve/tenancy.py), the SLO engine gains
+  per-tenant SLO instances whose burn alerts tighten only the burning
+  tenant's quota (QuotaGovernor), and the BENCH record gains a
+  per-tenant verdict table: every well-behaved tenant must end HELD
+  and the flooder QUOTA-CAPPED for the run to pass;
 * **deployment** (``--deploy``) — a fresh validated checkpoint lands
   mid-soak and a :class:`~mx_rcnn_tpu.ctrl.Deployer` stages, gates and
   rolls it live (docs/deployment.md): the BENCH record carries the
@@ -63,6 +71,8 @@ from tools.loadgen import (
     _occupancy_summary,
     _percentile,
     make_profile,
+    parse_tenant_load_spec,
+    tenant_table_string,
 )
 
 
@@ -120,7 +130,7 @@ class _SoakRunner:
         ]
 
 
-def _build_fake_fleet(args):
+def _build_fake_fleet(args, tenancy=None):
     from mx_rcnn_tpu.serve import FleetRouter, InferenceEngine
 
     def factory(rid: int) -> InferenceEngine:
@@ -129,15 +139,18 @@ def _build_fake_fleet(args):
             replica_id=rid,
             hang_timeout=60.0,
             max_queue=args.max_queue,
+            tenancy=tenancy,
+            tenancy_admit=False,  # the router charges the quota
         )
 
     return FleetRouter(
         factory, args.replicas,
         supervisor_poll=0.05, hedge_after=None,
+        tenancy=tenancy,
     )
 
 
-def _build_real_fleet(args):
+def _build_real_fleet(args, tenancy=None):
     import jax
 
     from mx_rcnn_tpu.config import get_config
@@ -149,11 +162,13 @@ def _build_real_fleet(args):
         TwoStageDetector(cfg=cfg.model), jax.random.PRNGKey(0),
         cfg.data.image_size,
     )
+    kwargs = {} if tenancy is None else {"tenancy": tenancy}
     return build_fleet(
         cfg, variables, args.replicas,
         engine_kwargs={"hang_timeout": 300.0, "max_queue": args.max_queue},
         supervisor_poll=0.1,
         hedge_after="auto",
+        **kwargs,
     )
 
 
@@ -236,15 +251,29 @@ def run_soak(args: argparse.Namespace) -> dict:
         ScalePolicy,
         SLOEngine,
         default_slos,
+        tenant_slos,
     )
-    from mx_rcnn_tpu.serve import Overloaded, ServeError
+    from mx_rcnn_tpu.serve import (
+        Overloaded,
+        QuotaExceeded,
+        QuotaGovernor,
+        ServeError,
+        TenancyPolicy,
+    )
+    from mx_rcnn_tpu.serve.tenancy import parse_table
 
     obs.configure(args.obs_dir, flush_s=max(args.ctrl_period, 0.5))
     print(f"[soak] obs: run_id={obs.run_id()} dir={obs.out_dir()}",
           file=sys.stderr)
 
+    tenant_specs = getattr(args, "_tenant_specs", None)
+    policy = None
+    if tenant_specs:
+        policy = TenancyPolicy(
+            parse_table(tenant_table_string(tenant_specs))
+        )
     fleet = (_build_fake_fleet if args.fake_engines
-             else _build_real_fleet)(args)
+             else _build_real_fleet)(args, tenancy=policy)
     mode = "fake" if args.fake_engines else "real"
     print(f"[soak] starting {args.replicas} {mode} replica(s)...",
           file=sys.stderr)
@@ -262,9 +291,21 @@ def run_soak(args: argparse.Namespace) -> dict:
         latency_target=args.latency_target,
         latency_threshold_s=args.latency_threshold,
     )
+    slos = default_slos(ctrl)
+    governor = None
+    if tenant_specs:
+        # Per-tenant SLO instances for the WELL-BEHAVED tenants only:
+        # the flooder is judged by its quota cap, not an SLO it is
+        # expected to blow; its burn must never reach the governor.
+        well_behaved = [
+            e["name"] for e in tenant_specs if e["role"] != "flooder"
+        ]
+        slos = slos + tenant_slos(ctrl, well_behaved)
+        governor = QuotaGovernor(policy)
     slo_engine = SLOEngine(
-        default_slos(ctrl), fast_s=fast_s, slow_s=slow_s,
+        slos, fast_s=fast_s, slow_s=slow_s,
         burn_factor=args.burn_factor,
+        on_alert=None if governor is None else governor.on_alert,
     ).start(args.ctrl_period)
     scaler = Autoscaler(
         fleet,
@@ -321,21 +362,37 @@ def run_soak(args: argparse.Namespace) -> dict:
 
     lock = threading.Lock()
     by_level: dict[str, list[float]] = {}
-    submitted = shed = failed = 0
+    submitted = shed = quota = failed = 0
     pending: list[threading.Thread] = []
+    tstats: dict[str, dict] = {
+        e["name"]: {"submitted": 0, "shed": 0, "quota": 0, "failed": 0,
+                    "lat": []}
+        for e in (tenant_specs or [])
+    }
 
-    def collect(freq, t_submit: float) -> None:
-        nonlocal failed
+    def collect(freq, t_submit: float, tenant: str | None = None) -> None:
+        nonlocal quota, failed
+        ts = tstats.get(tenant)
         try:
             res = freq.result(timeout=args.deadline + 60.0)
+        except QuotaExceeded:
+            with lock:
+                quota += 1
+                if ts is not None:
+                    ts["quota"] += 1
+            return
         except ServeError:
             with lock:
                 failed += 1
+                if ts is not None:
+                    ts["failed"] += 1
             return
         lat = time.monotonic() - t_submit
         level = res.get("level", "full")
         with lock:
             by_level.setdefault(level, []).append(lat)
+            if ts is not None:
+                ts["lat"].append(lat)
 
     chaos_procs: list[subprocess.Popen] = []
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -348,15 +405,10 @@ def run_soak(args: argparse.Namespace) -> dict:
     t0 = time.monotonic()
     next_at = t0
     deadline_wall = t0 + args.duration
-    while True:
-        now = time.monotonic()
-        if now >= deadline_wall:
-            break
-        if now < next_at:
-            time.sleep(min(next_at - now, 0.02))
-            continue
-        t = now - t0
-        next_at += 1.0 / (base(t) * burst(t))
+
+    def chaos_tick(t: float) -> None:
+        """The soak's mid-run events, shared by both arrival shapes."""
+        nonlocal killed_rid
         if deployer is not None and not deploy_drop_t \
                 and t >= args.duration * 0.3:
             deploy_drop_t.append(t)
@@ -370,31 +422,123 @@ def run_soak(args: argparse.Namespace) -> dict:
         if args.kill_replica and killed_rid is None \
                 and t >= args.duration * 0.4:
             # Kill a currently-routable replica (rids are sparse under
-            # autoscaling, so pick from live stats, not range()).
+            # autoscaling, so pick from live stats, not range()).  Only
+            # with a failover target standing: killing the LAST routable
+            # replica can't prove resilience, only loss — if the
+            # autoscaler has drained to one, wait for the next tick.
             live = [rep["rid"] for rep in fleet.stats()["replica"]
                     if rep["state"] in ("ready", "degraded")]
-            if live:
+            if len(live) >= 2:
                 killed_rid = min(live)
                 fleet.kill_replica(killed_rid, "soak chaos")
                 print(f"[soak] killed replica {killed_rid} at "
                       f"t={t:.1f}s", file=sys.stderr)
-        try:
-            freq = fleet.submit(img, timeout=args.deadline)
-        except Overloaded:
+
+    if tenant_specs:
+        # One open-loop schedule per tenant (same shape as tools/
+        # loadgen.py --tenants): the flooder bouncing off its quota
+        # never slows the victims' offered load, and a bursty tenant
+        # rides its own spike profile.
+        period = args.duration / args.cycles
+
+        def tenant_loop(ent: dict) -> None:
+            nonlocal submitted, shed, quota, failed
+            name = ent["name"]
+            ts = tstats[name]
+            rate = make_profile(
+                ent["profile"],
+                ent["qps"] if ent["qps"]
+                else max(args.qps / len(tenant_specs), 0.1),
+                amplitude=args.amplitude, period_s=period,
+                spike_factor=args.spike_factor, duty=args.duty,
+            )
+            nxt = t0
+            while True:
+                now = time.monotonic()
+                if now >= deadline_wall:
+                    return
+                if now < nxt:
+                    time.sleep(min(nxt - now, 0.02))
+                    continue
+                nxt += 1.0 / rate(now - t0)
+                try:
+                    freq = fleet.submit(
+                        img, timeout=args.deadline, tenant=name
+                    )
+                except QuotaExceeded:
+                    with lock:
+                        submitted += 1
+                        quota += 1
+                        ts["submitted"] += 1
+                        ts["quota"] += 1
+                    continue
+                except Overloaded:
+                    with lock:
+                        submitted += 1
+                        shed += 1
+                        ts["submitted"] += 1
+                        ts["shed"] += 1
+                    continue
+                except ServeError:
+                    with lock:
+                        submitted += 1
+                        failed += 1
+                        ts["submitted"] += 1
+                        ts["failed"] += 1
+                    continue
+                with lock:
+                    submitted += 1
+                    ts["submitted"] += 1
+                th = threading.Thread(
+                    target=collect, args=(freq, now, name), daemon=True
+                )
+                th.start()
+                pending.append(th)
+
+        loops = [
+            threading.Thread(target=tenant_loop, args=(e,), daemon=True)
+            for e in tenant_specs
+        ]
+        for th in loops:
+            th.start()
+        while True:
+            now = time.monotonic()
+            if now >= deadline_wall:
+                break
+            chaos_tick(now - t0)
+            time.sleep(0.05)
+        for th in loops:
+            th.join(timeout=args.duration + 120.0)
+    else:
+        while True:
+            now = time.monotonic()
+            if now >= deadline_wall:
+                break
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.02))
+                continue
+            t = now - t0
+            next_at += 1.0 / (base(t) * burst(t))
+            chaos_tick(t)
+            try:
+                freq = fleet.submit(img, timeout=args.deadline)
+            except Overloaded:
+                with lock:
+                    submitted += 1
+                    shed += 1
+                continue
+            except ServeError:
+                with lock:
+                    submitted += 1
+                    failed += 1
+                continue
             with lock:
                 submitted += 1
-                shed += 1
-            continue
-        except ServeError:
-            with lock:
-                submitted += 1
-                failed += 1
-            continue
-        with lock:
-            submitted += 1
-        th = threading.Thread(target=collect, args=(freq, now), daemon=True)
-        th.start()
-        pending.append(th)
+            th = threading.Thread(
+                target=collect, args=(freq, now), daemon=True
+            )
+            th.start()
+            pending.append(th)
 
     print(f"[soak] load window done ({submitted} arrivals); draining...",
           file=sys.stderr)
@@ -422,6 +566,40 @@ def run_soak(args: argparse.Namespace) -> dict:
                   file=sys.stderr)
 
     verdicts = slo_engine.verdicts()
+    tenants_rec = None
+    if tenant_specs:
+        # Per-tenant verdict table: well-behaved tenants must have every
+        # tenant-scoped SLO held; the flooder is judged by its cap — a
+        # flooder that was never quota-limited means the bucket leaked.
+        vds_by_tenant: dict[str, list] = {}
+        for v in verdicts:
+            if v.get("tenant"):
+                vds_by_tenant.setdefault(v["tenant"], []).append(v)
+        tenants_rec = {}
+        for e in tenant_specs:
+            name = e["name"]
+            ts = tstats[name]
+            lat = sorted(ts["lat"])
+            vds = vds_by_tenant.get(name, [])
+            slo_held = all(v["held"] for v in vds) if vds else None
+            if e["role"] == "flooder":
+                verdict = "QUOTA-CAPPED" if ts["quota"] > 0 else "UNCAPPED"
+            elif slo_held is not False and ts["failed"] == 0 and lat:
+                verdict = "HELD"
+            else:
+                verdict = "VIOLATED"
+            tenants_rec[name] = {
+                "role": e["role"],
+                "submitted": ts["submitted"],
+                "completed": len(lat),
+                "shed": ts["shed"],
+                "quota": ts["quota"],
+                "failed": ts["failed"],
+                "p50_s": round(_percentile(lat, 0.50), 4),
+                "p99_s": round(_percentile(lat, 0.99), 4),
+                "slo_held": slo_held,
+                "verdict": verdict,
+            }
     completed = sum(len(v) for v in by_level.values())
     latency_by_level = {}
     for level, vals in sorted(by_level.items()):
@@ -448,6 +626,7 @@ def run_soak(args: argparse.Namespace) -> dict:
         "submitted": submitted,
         "completed": completed,
         "shed": shed,
+        "quota": quota,
         "failed": failed,
         "killed_rid": killed_rid,
         "quarantines": stats["quarantines"],
@@ -485,6 +664,10 @@ def run_soak(args: argparse.Namespace) -> dict:
         "resize_timeline": [
             {**d, "t": round(d["t"] - t0, 2)}
             for d in scaler.resize_timeline()
+        ],
+        "tenants": tenants_rec,
+        "quota_governor": None if governor is None else [
+            {"action": a, "tenant": t} for a, t in governor.actions
         ],
         "data_chaos": chaos,
         "deploy": None if deployer is None else dict(
@@ -552,9 +735,21 @@ def main(argv=None) -> int:
     p.add_argument("--deploy-ckpt-dir", default=None,
                    help="--deploy: checkpoint dir to land the candidate "
                         "in (default: a temp dir)")
+    p.add_argument("--tenants", default="",
+                   help="adversarial multi-tenant mix (same spec as "
+                        "tools/loadgen.py --tenants): per-tenant "
+                        "schedules + serve.tenancy quotas + per-tenant "
+                        "SLO verdicts in the BENCH record; the "
+                        "role=flooder tenant must end QUOTA-CAPPED and "
+                        "every other tenant HELD for the run to pass")
     p.add_argument("--obs-dir", default=None,
                    help="obs journal/spans dir (default: a temp dir)")
     args = p.parse_args(argv)
+    if args.tenants:
+        try:
+            args._tenant_specs = parse_tenant_load_spec(args.tenants)
+        except ValueError as e:
+            p.error(str(e))
     if args.obs_dir is None:
         import tempfile
 
@@ -576,6 +771,19 @@ def main(argv=None) -> int:
         # verdicts above must hold THROUGH the roll — a promote that
         # burns the budget fails the soak even after rollback.
         ok = ok and rec["deploy"] is not None and rec["deploy"]["decided"]
+    if args.tenants and rec["tenants"] is not None:
+        # Isolation proof: every well-behaved tenant HELD, and the
+        # flooder actually hit its cap (an uncapped flooder means the
+        # bucket never bit — the rehearsal proved nothing).
+        tnts = rec["tenants"].values()
+        ok = ok and all(
+            t["verdict"] == "HELD" for t in tnts if t["role"] != "flooder"
+        )
+        flooders = [t for t in tnts if t["role"] == "flooder"]
+        if flooders:
+            ok = ok and any(
+                t["verdict"] == "QUOTA-CAPPED" for t in flooders
+            )
     rec["held"] = held
     rec["pass"] = ok
     print(json.dumps(rec))
@@ -586,6 +794,13 @@ def main(argv=None) -> int:
               f"held={v['held']}", file=sys.stderr)
     print(f"[soak] fleet resizes: +{rec['added']} -{rec['retired']} "
           f"(final {rec['replicas_final']})", file=sys.stderr)
+    if rec.get("tenants"):
+        for name, t in rec["tenants"].items():
+            print(f"[soak] tenant {name} ({t['role']}): "
+                  f"submitted={t['submitted']} completed={t['completed']} "
+                  f"shed={t['shed']} quota={t['quota']} "
+                  f"failed={t['failed']} p99={t['p99_s']}s "
+                  f"verdict={t['verdict']}", file=sys.stderr)
     if rec.get("deploy"):
         d = rec["deploy"]
         story = "promoted" if d["promoted"] else (
